@@ -1,0 +1,12 @@
+"""luminaai_tpu — TPU-native adaptive training framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of MatN23/LuminaAI
+(dense + MoE + MoD transformers, adaptive orchestration, distributed training)
+targeting TPU meshes via jax.sharding/pjit instead of CUDA/DeepSpeed.
+"""
+
+__version__ = "0.1.0"
+
+from luminaai_tpu.config import Config, ConfigManager, ConfigPresets
+
+__all__ = ["Config", "ConfigManager", "ConfigPresets", "__version__"]

@@ -1,0 +1,725 @@
+"""Configuration system for the LuminaAI TPU-native framework.
+
+Covers the reference's config surface (ref: Src/Main_Scripts/config/config_manager.py:15
+``Config``, :759 ``ConfigPresets``, :1871 ``ConfigManager``) re-designed for TPU:
+the DeepSpeed/NCCL fields are replaced by a `jax.sharding.Mesh` axis layout
+(data / fsdp / tensor / expert / sequence parallelism).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+try:
+    import yaml
+
+    _HAS_YAML = True
+except Exception:  # pragma: no cover
+    _HAS_YAML = False
+
+MOE_PATTERNS = ("all", "every_3rd", "every_4th", "sandwich", "none")
+LR_SCHEDULES = ("cosine", "linear", "constant", "wsd")
+PRECISIONS = ("auto", "fp32", "bf16", "mixed_bf16", "fp16", "mixed_fp16")
+
+
+@dataclass
+class Config:
+    """Single source of truth for model + training + runtime configuration.
+
+    Field groups mirror the reference Config (config_manager.py:15) with
+    TPU-native parallelism fields replacing the DeepSpeed group.
+    """
+
+    # --- Model architecture ---
+    vocab_size: int = 50304
+    hidden_size: int = 512
+    num_layers: int = 8
+    num_heads: int = 8
+    num_kv_heads: Optional[int] = 4
+    seq_length: int = 1024
+    intermediate_size: Optional[int] = None  # auto: 8/3 * hidden, rounded
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    dropout: float = 0.0
+    tie_word_embeddings: bool = True
+    use_stable_embedding: bool = True
+    init_std: float = 0.02
+    use_flash_attention: bool = True
+    flash_block_q: int = 512
+    flash_block_kv: int = 512
+
+    # --- MoE ---
+    use_moe: bool = False
+    num_experts: int = 8
+    moe_top_k: int = 2
+    capacity_factor: float = 1.25
+    load_balancing_weight: float = 0.01
+    router_z_loss_weight: float = 1e-3
+    routing_temperature: float = 1.0
+    routing_noise_std: float = 0.1
+    moe_pattern: str = "all"
+    dense_start_layers: int = 2
+    dense_end_layers: int = 2
+    expert_output_scaling: float = 1.0
+
+    # --- MoD (mixture of depths) ---
+    use_mod: bool = False
+    mod_capacity_factor: float = 0.5
+    mod_routing_temperature: float = 1.0
+
+    # --- Training ---
+    batch_size: int = 8  # global batch (sequences)
+    micro_batch_size: Optional[int] = None  # per grad-accum slice; auto
+    gradient_accumulation_steps: int = 1
+    learning_rate: float = 3e-4
+    weight_decay: float = 0.01
+    beta1: float = 0.9
+    beta2: float = 0.95
+    eps: float = 1e-8
+    grad_clip_norm: float = 1.0
+    num_epochs: int = 3
+    max_steps: Optional[int] = None
+    warmup_ratio: float = 0.15
+    lr_scheduler: str = "cosine"
+    use_lr_scheduler: bool = True
+    min_lr: float = 1e-6
+    precision: str = "auto"  # auto|fp32|bf16|mixed_bf16|mixed_fp16
+    inference_precision: str = "auto"
+    gradient_checkpointing: bool = True
+    remat_policy: str = "nothing_saveable"  # nothing_saveable|dots_saveable|full
+    scan_layers: bool = False  # lax.scan over layers (homogeneous stacks)
+    donate_state: bool = True
+    eval_every_n_batches: int = 500
+    save_every_n_batches: int = 1000
+    assistant_loss_weight: float = 1.5
+    z_loss_weight: float = 0.0
+    label_smoothing: float = 0.0
+
+    # --- Parallelism (replaces ref DeepSpeed/FSDP/ColossalAI group) ---
+    mesh_axes: tuple = ("data", "fsdp", "expert", "tensor", "sequence")
+    data_parallel_size: int = -1  # -1 = infer remaining devices
+    fsdp_parallel_size: int = 1
+    expert_parallel_size: int = 1
+    tensor_parallel_size: int = 1
+    sequence_parallel_size: int = 1
+    use_ring_attention: bool = False  # required when sequence_parallel_size > 1
+    allow_split_physical_axes: bool = False
+    multihost: bool = False  # call jax.distributed.initialize()
+    coordinator_address: Optional[str] = None
+    process_id: Optional[int] = None
+    num_processes: Optional[int] = None
+
+    # --- Data ---
+    train_data_path: str = "data/train.jsonl"
+    eval_data_path: str = "data/eval.jsonl"
+    tokenizer_name: str = "gpt2"
+    num_workers: int = 2
+    max_conversations_per_file: int = 10000
+    streaming_threshold_gb: float = 10.0
+    prefetch_batches: int = 2
+    pack_sequences: bool = True
+    use_native_dataloader: bool = True  # C++ memmap packer when available
+
+    # --- Generation ---
+    max_new_tokens: int = 512
+    temperature: float = 0.8
+    top_p: float = 0.9
+    top_k: int = 50
+    repetition_penalty: float = 1.05
+
+    # --- Production / experiment ---
+    experiment_name: Optional[str] = None
+    output_dir: str = "experiments"
+    seed: int = 42
+    log_level: str = "INFO"
+    save_total_limit: int = 5
+    early_stopping_patience: Optional[int] = None
+    auto_resume: bool = True
+    backup_every_n_hours: int = 6
+    max_retries: int = 3
+    enable_wandb: bool = False
+    wandb_project: Optional[str] = None
+    wandb_entity: Optional[str] = None
+
+    # --- Monitoring / fault tolerance ---
+    health_check_interval: int = 100
+    loss_spike_threshold: float = 2.0
+    grad_norm_threshold: float = 100.0
+    expert_collapse_threshold: float = 0.05
+
+    # --- Adaptive control (orchestrator) ---
+    enable_adaptive_lr: bool = True
+    allow_scheduler_override: bool = True
+    min_override_threshold: float = 0.2
+    emergency_override_enabled: bool = True
+    log_lr_decisions: bool = True
+    enable_architecture_evolution: bool = False
+    intervention_cooldown_steps: int = 200
+
+    # --- Chinchilla scaling ---
+    use_chinchilla_scaling: bool = False
+    tokens_per_param: float = 20.0
+    convergence_patience: int = 5
+
+    # --- Memory ---
+    max_memory_usage: float = 0.9
+    host_offload_optimizer: bool = False  # ref cpu_offload_* analogue
+
+    def __post_init__(self):
+        if self.num_kv_heads is None:
+            self.num_kv_heads = self.num_heads
+        if self.intermediate_size is None:
+            # SwiGLU sizing: 8/3 * hidden, rounded up to a multiple of 128
+            # (MXU lane width) — ref auto-calcs 4*hidden for plain FFN.
+            raw = int(8 * self.hidden_size / 3)
+            self.intermediate_size = ((raw + 127) // 128) * 128
+        if self.micro_batch_size is None:
+            self.micro_batch_size = max(
+                1, self.batch_size // max(1, self.gradient_accumulation_steps)
+            )
+        if isinstance(self.mesh_axes, list):
+            self.mesh_axes = tuple(self.mesh_axes)
+        self.validate()
+
+    # -- validation ------------------------------------------------------
+    def validate(self) -> None:
+        assert self.hidden_size % self.num_heads == 0, (
+            "hidden_size must be divisible by num_heads"
+        )
+        assert self.num_heads % self.num_kv_heads == 0, (
+            "num_heads must be divisible by num_kv_heads"
+        )
+        assert self.precision in PRECISIONS, f"invalid precision {self.precision}"
+        assert self.lr_scheduler in LR_SCHEDULES, (
+            f"invalid lr_scheduler {self.lr_scheduler}"
+        )
+        if self.use_moe:
+            assert self.moe_top_k <= self.num_experts, "moe_top_k must be <= num_experts"
+            assert self.moe_pattern in MOE_PATTERNS, (
+                f"invalid moe_pattern {self.moe_pattern}"
+            )
+            assert self.capacity_factor > 0
+        if self.use_mod:
+            assert 0.0 < self.mod_capacity_factor <= 1.0, (
+                "mod_capacity_factor must be in (0, 1]"
+            )
+        if self.sequence_parallel_size > 1:
+            assert self.seq_length % self.sequence_parallel_size == 0
+        for axis in ("fsdp", "expert", "tensor", "sequence"):
+            size = getattr(self, f"{axis}_parallel_size")
+            assert size >= 1, f"{axis}_parallel_size must be >= 1"
+        if self.expert_parallel_size > 1 and self.use_moe:
+            assert self.num_experts % self.expert_parallel_size == 0, (
+                "num_experts must divide evenly over expert_parallel_size"
+            )
+
+    # -- derived quantities (ref config_manager.py:234,505,572) ----------
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    def estimate_parameters(self) -> int:
+        """Total parameter count (ref core/model.py:91 estimate_parameters)."""
+        h, v, L = self.hidden_size, self.vocab_size, self.num_layers
+        inter = self.intermediate_size
+        kv_dim = self.num_kv_heads * self.head_dim()
+        embed = v * h if self.tie_word_embeddings else 2 * v * h
+        attn = h * h + 2 * h * kv_dim + h * h  # q, k, v, o
+        ffn_dense = 3 * h * inter  # gate, up, down
+        per_layer_norms = 2 * h
+        total = embed + L * (attn + per_layer_norms) + h  # final norm
+        moe_layers = self.num_moe_layers()
+        dense_layers = L - moe_layers
+        total += dense_layers * ffn_dense
+        total += moe_layers * (self.num_experts * ffn_dense + h * self.num_experts)
+        if self.use_mod:
+            total += L * h  # MoD routers
+        return total
+
+    def estimate_active_parameters(self) -> int:
+        """Active (per-token) params (ref core/model.py:1808)."""
+        total = self.estimate_parameters()
+        if not self.use_moe:
+            return total
+        h, inter = self.hidden_size, self.intermediate_size
+        ffn_dense = 3 * h * inter
+        moe_layers = self.num_moe_layers()
+        inactive = moe_layers * (self.num_experts - self.moe_top_k) * ffn_dense
+        return total - inactive
+
+    def num_moe_layers(self) -> int:
+        if not self.use_moe:
+            return 0
+        return sum(1 for i in range(self.num_layers) if self.is_moe_layer(i))
+
+    def is_moe_layer(self, layer_idx: int) -> bool:
+        """MoE layer placement pattern (ref core/model.py:1545 _should_use_moe)."""
+        if not self.use_moe or self.moe_pattern == "none":
+            return False
+        if self.moe_pattern == "all":
+            return True
+        if self.moe_pattern == "every_3rd":
+            return layer_idx % 3 == 2
+        if self.moe_pattern == "every_4th":
+            return layer_idx % 4 == 3
+        if self.moe_pattern == "sandwich":
+            return (
+                self.dense_start_layers <= layer_idx
+                < self.num_layers - self.dense_end_layers
+            )
+        return False
+
+    def memory_estimate_gb(self) -> Dict[str, float]:
+        """Rough HBM footprint estimate (ref config_manager.py:572)."""
+        params = self.estimate_parameters()
+        bytes_per = 2 if "bf16" in self.resolve_precision() else 4
+        param_gb = params * bytes_per / 1e9
+        # Adam: 2 fp32 moments + fp32 master copy when training in bf16
+        opt_gb = params * 12 / 1e9
+        act_gb = (
+            self.micro_batch_size
+            * self.seq_length
+            * self.hidden_size
+            * self.num_layers
+            * bytes_per
+            * (2 if not self.gradient_checkpointing else 0.25)
+        ) / 1e9
+        total = param_gb + opt_gb + act_gb
+        return {
+            "parameters_gb": round(param_gb, 3),
+            "optimizer_gb": round(opt_gb, 3),
+            "activations_gb": round(act_gb, 3),
+            "total_gb": round(total, 3),
+        }
+
+    def resolve_precision(self, for_inference: bool = False) -> str:
+        p = self.inference_precision if for_inference else self.precision
+        if p == "auto":
+            return "bf16" if for_inference else "mixed_bf16"
+        return p
+
+    def total_mesh_size(self) -> int:
+        return (
+            max(1, self.data_parallel_size)
+            * self.fsdp_parallel_size
+            * self.expert_parallel_size
+            * self.tensor_parallel_size
+            * self.sequence_parallel_size
+        )
+
+    # -- serialization (ref config_manager.py:616,637) --------------------
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["mesh_axes"] = list(self.mesh_axes)
+        return d
+
+    def save(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        d = self.to_dict()
+        with open(path, "w") as f:
+            if path.endswith((".yaml", ".yml")) and _HAS_YAML:
+                yaml.safe_dump(d, f, sort_keys=False)
+            else:
+                json.dump(d, f, indent=2)
+
+    @classmethod
+    def load(cls, path: str) -> "Config":
+        with open(path) as f:
+            if path.endswith((".yaml", ".yml")) and _HAS_YAML:
+                d = yaml.safe_load(f)
+            else:
+                d = json.load(f)
+        known = {f.name for f in dataclasses.fields(cls)}
+        d = {k: v for k, v in d.items() if k in known}
+        return cls(**d)
+
+
+class ConfigPresets:
+    """Model-size presets following the reference's 8x-MoE pattern
+    (ref config_manager.py:759). Sizes name the *active* parameter count."""
+
+    @staticmethod
+    def debug() -> Config:
+        return Config(
+            vocab_size=1024,
+            hidden_size=128,
+            num_layers=2,
+            num_heads=2,
+            num_kv_heads=1,
+            seq_length=256,
+            intermediate_size=256,
+            batch_size=2,
+            micro_batch_size=1,
+            gradient_accumulation_steps=2,
+            num_epochs=1,
+            learning_rate=5e-5,
+            use_moe=True,
+            num_experts=8,
+            moe_top_k=2,
+            capacity_factor=1.1,
+            load_balancing_weight=0.005,
+            eval_every_n_batches=50,
+            save_every_n_batches=100,
+            experiment_name="debug_run",
+            log_level="DEBUG",
+            health_check_interval=10,
+            save_total_limit=3,
+            gradient_checkpointing=False,
+            scan_layers=False,
+        )
+
+    @staticmethod
+    def debug_200m() -> Config:
+        return Config(
+            vocab_size=50304,
+            hidden_size=768,
+            num_layers=12,
+            num_heads=12,
+            num_kv_heads=4,
+            seq_length=2048,
+            batch_size=32,
+            gradient_accumulation_steps=4,
+            use_moe=False,
+            use_mod=True,
+            mod_capacity_factor=0.5,
+            experiment_name="debug_200m",
+        )
+
+    @staticmethod
+    def debug_300m() -> Config:
+        return Config(
+            vocab_size=50304,
+            hidden_size=768,
+            num_layers=6,
+            num_heads=4,
+            num_kv_heads=2,
+            seq_length=1024,
+            batch_size=16,
+            use_moe=True,
+            num_experts=8,
+            moe_top_k=2,
+            experiment_name="debug_300m",
+        )
+
+    @staticmethod
+    def moe_stress_test() -> Config:
+        return Config(
+            vocab_size=50304,
+            hidden_size=512,
+            num_layers=8,
+            num_heads=8,
+            num_kv_heads=4,
+            seq_length=1024,
+            batch_size=8,
+            use_moe=True,
+            num_experts=32,
+            moe_top_k=2,
+            capacity_factor=1.1,
+            routing_noise_std=0.2,
+            expert_parallel_size=1,
+            experiment_name="moe_stress_test",
+        )
+
+    @staticmethod
+    def b1() -> Config:
+        return Config(
+            vocab_size=50304,
+            hidden_size=2048,
+            num_layers=16,
+            num_heads=16,
+            num_kv_heads=4,
+            seq_length=2048,
+            batch_size=128,
+            gradient_accumulation_steps=8,
+            use_moe=True,
+            num_experts=8,
+            moe_top_k=2,
+            fsdp_parallel_size=8,
+            experiment_name="b1",
+        )
+
+    @staticmethod
+    def b7() -> Config:
+        return Config(
+            vocab_size=50304,
+            hidden_size=4096,
+            num_layers=32,
+            num_heads=32,
+            num_kv_heads=8,
+            seq_length=2048,
+            batch_size=512,
+            gradient_accumulation_steps=16,
+            learning_rate=1.5e-4,
+            use_moe=True,
+            num_experts=8,
+            moe_top_k=2,
+            fsdp_parallel_size=8,
+            expert_parallel_size=8,
+            experiment_name="b7",
+        )
+
+    @staticmethod
+    def b14() -> Config:
+        return Config(
+            vocab_size=50304,
+            hidden_size=5120,
+            num_layers=40,
+            num_heads=40,
+            num_kv_heads=8,
+            seq_length=4096,
+            batch_size=512,
+            gradient_accumulation_steps=16,
+            learning_rate=1.2e-4,
+            use_moe=True,
+            num_experts=8,
+            moe_top_k=2,
+            fsdp_parallel_size=16,
+            expert_parallel_size=8,
+            experiment_name="b14",
+        )
+
+    @staticmethod
+    def b30() -> Config:
+        return Config(
+            vocab_size=50304,
+            hidden_size=6656,
+            num_layers=48,
+            num_heads=52,
+            num_kv_heads=13,
+            seq_length=4096,
+            batch_size=1024,
+            gradient_accumulation_steps=32,
+            learning_rate=1e-4,
+            use_moe=True,
+            num_experts=8,
+            moe_top_k=2,
+            fsdp_parallel_size=32,
+            expert_parallel_size=8,
+            experiment_name="b30",
+        )
+
+    @staticmethod
+    def b50() -> Config:
+        return Config(
+            vocab_size=50304,
+            hidden_size=8192,
+            num_layers=48,
+            num_heads=64,
+            num_kv_heads=8,
+            seq_length=4096,
+            batch_size=1024,
+            gradient_accumulation_steps=32,
+            learning_rate=8e-5,
+            use_moe=True,
+            num_experts=16,
+            moe_top_k=2,
+            fsdp_parallel_size=32,
+            expert_parallel_size=16,
+            experiment_name="b50",
+        )
+
+    @staticmethod
+    def b75() -> Config:
+        return Config(
+            vocab_size=50304,
+            hidden_size=8192,
+            num_layers=64,
+            num_heads=64,
+            num_kv_heads=8,
+            seq_length=8192,
+            batch_size=1024,
+            gradient_accumulation_steps=32,
+            learning_rate=7e-5,
+            use_moe=True,
+            num_experts=16,
+            moe_top_k=2,
+            fsdp_parallel_size=64,
+            expert_parallel_size=16,
+            use_ring_attention=True,
+            sequence_parallel_size=1,
+            experiment_name="b75",
+        )
+
+    @staticmethod
+    def b100() -> Config:
+        return Config(
+            vocab_size=50304,
+            hidden_size=10240,
+            num_layers=64,
+            num_heads=80,
+            num_kv_heads=8,
+            seq_length=8192,
+            batch_size=2048,
+            gradient_accumulation_steps=64,
+            learning_rate=6e-5,
+            use_moe=True,
+            num_experts=32,
+            moe_top_k=2,
+            fsdp_parallel_size=64,
+            expert_parallel_size=32,
+            use_ring_attention=True,
+            experiment_name="b100",
+        )
+
+    @staticmethod
+    def b200() -> Config:
+        return Config(
+            vocab_size=50304,
+            hidden_size=12288,
+            num_layers=80,
+            num_heads=96,
+            num_kv_heads=8,
+            seq_length=8192,
+            batch_size=2048,
+            gradient_accumulation_steps=64,
+            learning_rate=5e-5,
+            use_moe=True,
+            num_experts=64,
+            moe_top_k=2,
+            fsdp_parallel_size=128,
+            expert_parallel_size=64,
+            use_ring_attention=True,
+            experiment_name="b200",
+        )
+
+    @staticmethod
+    def b300() -> Config:
+        return Config(
+            vocab_size=50304,
+            hidden_size=16384,
+            num_layers=80,
+            num_heads=128,
+            num_kv_heads=16,
+            seq_length=8192,
+            batch_size=4096,
+            gradient_accumulation_steps=128,
+            learning_rate=4e-5,
+            use_moe=True,
+            num_experts=64,
+            moe_top_k=2,
+            fsdp_parallel_size=128,
+            expert_parallel_size=64,
+            tensor_parallel_size=2,
+            use_ring_attention=True,
+            experiment_name="b300",
+        )
+
+    _PRESETS = (
+        "debug",
+        "debug_200m",
+        "debug_300m",
+        "moe_stress_test",
+        "b1",
+        "b7",
+        "b14",
+        "b30",
+        "b50",
+        "b75",
+        "b100",
+        "b200",
+        "b300",
+    )
+
+    @classmethod
+    def available(cls) -> List[str]:
+        return list(cls._PRESETS)
+
+    @classmethod
+    def get(cls, name: str) -> Config:
+        if name not in cls._PRESETS:
+            raise ValueError(f"Unknown preset: {name}. Available: {cls.available()}")
+        return getattr(cls, name)()
+
+    @classmethod
+    def get_preset_info(cls) -> Dict[str, Dict[str, Any]]:
+        """Comparison table across presets (ref config_manager.py:1670)."""
+        info = {}
+        for name in cls._PRESETS:
+            c = cls.get(name)
+            info[name] = {
+                "hidden_size": c.hidden_size,
+                "num_layers": c.num_layers,
+                "total_params": c.estimate_parameters(),
+                "active_params": c.estimate_active_parameters(),
+                "use_moe": c.use_moe,
+                "num_experts": c.num_experts if c.use_moe else 0,
+                "use_mod": c.use_mod,
+                "seq_length": c.seq_length,
+                "memory_gb": c.memory_estimate_gb()["total_gb"],
+            }
+        return info
+
+
+class ConfigManager:
+    """Create, validate, tune, persist configs (ref config_manager.py:1871)."""
+
+    @staticmethod
+    def create_config(preset: str = "b7", **overrides) -> Config:
+        config = ConfigPresets.get(preset)
+        config = dataclasses.replace(config, **overrides)
+        return config
+
+    @staticmethod
+    def validate_config(config: Config, strict: bool = False) -> List[str]:
+        """Returns a list of warnings; raises on hard errors (via validate())."""
+        config.validate()
+        warnings = []
+        if config.batch_size % max(1, config.micro_batch_size) != 0:
+            warnings.append("batch_size is not a multiple of micro_batch_size")
+        if config.use_moe and config.capacity_factor < 1.0:
+            warnings.append("capacity_factor < 1.0 will drop tokens aggressively")
+        if config.seq_length % 128 != 0:
+            warnings.append("seq_length not a multiple of 128 (TPU lane width)")
+        if config.hidden_size % 128 != 0:
+            warnings.append("hidden_size not a multiple of 128 (MXU tiling)")
+        mem = config.memory_estimate_gb()["total_gb"]
+        shards = config.fsdp_parallel_size * config.tensor_parallel_size
+        if mem / max(1, shards) > 90:
+            warnings.append(
+                f"~{mem / max(1, shards):.0f}GB/chip estimated — exceeds v5p HBM"
+            )
+        if strict and warnings:
+            raise ValueError("; ".join(warnings))
+        return warnings
+
+    @staticmethod
+    def optimize_for_hardware(config: Config, n_devices: Optional[int] = None) -> Config:
+        """Pick a mesh layout for the available devices
+        (ref config_manager.py:1921 optimize_for_hardware)."""
+        import jax
+
+        n = n_devices or jax.device_count()
+        updates: Dict[str, Any] = {}
+        # Shard experts first (cheap all-to-all on ICI), then FSDP the rest.
+        ep = 1
+        if config.use_moe:
+            ep = math.gcd(config.num_experts, n)
+        remaining = n // ep
+        updates["expert_parallel_size"] = ep
+        updates["fsdp_parallel_size"] = remaining
+        updates["data_parallel_size"] = 1
+        params_gb = config.estimate_parameters() * 2 / 1e9
+        if params_gb / max(1, remaining) > 16 and remaining >= 2:
+            updates["tensor_parallel_size"] = 2
+            updates["fsdp_parallel_size"] = remaining // 2
+        return dataclasses.replace(config, **updates)
+
+    @staticmethod
+    def save_config_with_metadata(config: Config, path: str) -> None:
+        d = config.to_dict()
+        d["_metadata"] = {
+            "total_params": config.estimate_parameters(),
+            "active_params": config.estimate_active_parameters(),
+            "memory_estimate": config.memory_estimate_gb(),
+            "framework": "luminaai_tpu",
+        }
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            if path.endswith((".yaml", ".yml")) and _HAS_YAML:
+                yaml.safe_dump(d, f, sort_keys=False)
+            else:
+                json.dump(d, f, indent=2)

@@ -1,0 +1,308 @@
+"""Core transformer layers, TPU-first (flax.linen).
+
+Covers the reference's dense compute path (ref: Src/Main_Scripts/core/model.py —
+RMSNorm:228, LayerNorm:307, RotaryEmbedding:334, DenseGroupedQueryAttention:565,
+SwiGLUExpert:1027, DenseSwiGLU:1406) re-designed for XLA: static shapes, einsum
+formulations that tile onto the MXU, bf16 compute with fp32 params, and logical
+axis names (`flax.linen.with_logical_partitioning`) so the same module runs
+under any dp/fsdp/tp/sp mesh layout.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from luminaai_tpu.config import Config
+
+Dtype = Any
+
+
+def default_init(std: float = 0.02):
+    return nn.initializers.normal(stddev=std)
+
+
+class RMSNorm(nn.Module):
+    """Root-mean-square norm (ref core/model.py:228). fp32 accumulation."""
+
+    eps: float = 1e-6
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        scale = self.param(
+            "scale",
+            nn.with_logical_partitioning(nn.initializers.ones, ("embed",)),
+            (x.shape[-1],),
+            jnp.float32,
+        )
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        y = x32 * jax.lax.rsqrt(var + self.eps)
+        return (y * scale).astype(self.dtype)
+
+
+class LayerNorm(nn.Module):
+    """Standard layernorm with optional bias (ref core/model.py:307)."""
+
+    eps: float = 1e-5
+    use_bias: bool = True
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        dim = x.shape[-1]
+        scale = self.param(
+            "scale",
+            nn.with_logical_partitioning(nn.initializers.ones, ("embed",)),
+            (dim,),
+            jnp.float32,
+        )
+        x32 = x.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.var(x32, axis=-1, keepdims=True)
+        y = (x32 - mean) * jax.lax.rsqrt(var + self.eps) * scale
+        if self.use_bias:
+            bias = self.param(
+                "bias",
+                nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
+                (dim,),
+                jnp.float32,
+            )
+            y = y + bias
+        return y.astype(self.dtype)
+
+
+def rope_frequencies(
+    head_dim: int, max_len: int, theta: float = 10000.0
+) -> Tuple[jax.Array, jax.Array]:
+    """Precompute RoPE cos/sin tables in fp32 (ref core/model.py:334).
+
+    Returns (cos, sin) of shape [max_len, head_dim//2].
+    """
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(
+    x: jax.Array, cos: jax.Array, sin: jax.Array, positions: Optional[jax.Array] = None
+) -> jax.Array:
+    """Rotate q/k (ref core/model.py:471 apply_rotary_pos_emb_optimized).
+
+    x: [B, S, H, D]; cos/sin: [max_len, D//2]; positions: [B, S] (optional).
+    Split-halves convention (x1 = x[..., :D/2], x2 = x[..., D/2:]).
+    """
+    d2 = x.shape[-1] // 2
+    if positions is None:
+        c = cos[None, : x.shape[1], None, :]
+        s = sin[None, : x.shape[1], None, :]
+    else:
+        c = cos[positions][:, :, None, :]
+        s = sin[positions][:, :, None, :]
+    x1, x2 = x[..., :d2], x[..., d2:]
+    x1f, x2f = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    out = jnp.concatenate([x1f * c - x2f * s, x2f * c + x1f * s], axis=-1)
+    return out.astype(x.dtype)
+
+
+class SwiGLU(nn.Module):
+    """Gated FFN: down(silu(gate(x)) * up(x)) (ref core/model.py:1406).
+
+    Fused gate+up projection: one [embed, 2*mlp] matmul keeps the MXU busy
+    instead of two half-width ones.
+    """
+
+    intermediate_size: int
+    dtype: Dtype = jnp.bfloat16
+    init_std: float = 0.02
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        hidden = x.shape[-1]
+        wi = self.param(
+            "wi",
+            nn.with_logical_partitioning(
+                default_init(self.init_std), ("embed", "mlp_fused")
+            ),
+            (hidden, 2 * self.intermediate_size),
+            jnp.float32,
+        )
+        wo = self.param(
+            "wo",
+            nn.with_logical_partitioning(
+                default_init(self.init_std / jnp.sqrt(2.0)), ("mlp", "embed")
+            ),
+            (self.intermediate_size, hidden),
+            jnp.float32,
+        )
+        fused = jnp.einsum("...d,df->...f", x, wi.astype(self.dtype))
+        gate, up = jnp.split(fused, 2, axis=-1)
+        act = nn.silu(gate) * up
+        return jnp.einsum("...f,fd->...d", act, wo.astype(self.dtype))
+
+
+class GQAttention(nn.Module):
+    """Grouped-query attention with RoPE (ref core/model.py:565).
+
+    Flash path: Pallas kernel on TPU (ops/flash_attention.py) replacing the
+    reference's FlashAttention-2 CUDA dependency; XLA einsum fallback
+    elsewhere. KV cache support for autoregressive decode.
+    """
+
+    config: Config
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,
+        *,
+        positions: Optional[jax.Array] = None,
+        kv_cache: Optional[Tuple[jax.Array, jax.Array]] = None,
+        cache_index: Optional[jax.Array] = None,
+        deterministic: bool = True,
+    ):
+        cfg = self.config
+        B, S, H = x.shape
+        n_q, n_kv = cfg.num_heads, cfg.num_kv_heads
+        d = cfg.head_dim()
+
+        wq = self.param(
+            "wq",
+            nn.with_logical_partitioning(
+                default_init(cfg.init_std), ("embed", "heads", "head_dim")
+            ),
+            (H, n_q, d),
+            jnp.float32,
+        )
+        wk = self.param(
+            "wk",
+            nn.with_logical_partitioning(
+                default_init(cfg.init_std), ("embed", "kv_heads", "head_dim")
+            ),
+            (H, n_kv, d),
+            jnp.float32,
+        )
+        wv = self.param(
+            "wv",
+            nn.with_logical_partitioning(
+                default_init(cfg.init_std), ("embed", "kv_heads", "head_dim")
+            ),
+            (H, n_kv, d),
+            jnp.float32,
+        )
+        wo = self.param(
+            "wo",
+            nn.with_logical_partitioning(
+                default_init(cfg.init_std / jnp.sqrt(2.0)),
+                ("heads", "head_dim", "embed"),
+            ),
+            (n_q, d, H),
+            jnp.float32,
+        )
+
+        q = jnp.einsum("bsd,dhk->bshk", x, wq.astype(self.dtype))
+        k = jnp.einsum("bsd,dhk->bshk", x, wk.astype(self.dtype))
+        v = jnp.einsum("bsd,dhk->bshk", x, wv.astype(self.dtype))
+
+        max_len = kv_cache[0].shape[1] if kv_cache is not None else cfg.seq_length
+        cos, sin = rope_frequencies(d, max_len, cfg.rope_theta)
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+
+        new_cache = None
+        if kv_cache is not None:
+            ck, cv = kv_cache
+            ck = jax.lax.dynamic_update_slice(ck, k, (0, cache_index, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v, (0, cache_index, 0, 0))
+            k, v = ck, cv
+            new_cache = (ck, cv)
+
+        q = nn.with_logical_constraint(
+            q, ("activation_batch", "activation_length", "activation_heads", None)
+        )
+
+        use_flash = (
+            cfg.use_flash_attention
+            and kv_cache is None
+            and S >= 128
+            and d % 128 == 0
+            and S % cfg.flash_block_q == 0
+        )
+        if use_flash:
+            from luminaai_tpu.ops.flash_attention import flash_attention
+
+            out = flash_attention(
+                q,
+                k,
+                v,
+                causal=True,
+                block_q=cfg.flash_block_q,
+                block_kv=cfg.flash_block_kv,
+            )
+        else:
+            out = self._xla_attention(q, k, v, kv_cache is not None, cache_index)
+
+        y = jnp.einsum("bshk,hkd->bsd", out, wo.astype(self.dtype))
+        return y, new_cache
+
+    def _xla_attention(self, q, k, v, decoding: bool, cache_index):
+        """Einsum attention fallback (ref core/model.py:783 _standard_attention).
+
+        Grouped heads handled by reshape [B,S,Kv,G,D] — XLA maps the group
+        dim onto the MXU batch dims; no head replication materialized.
+        """
+        B, Sq, n_q, d = q.shape
+        Skv, n_kv = k.shape[1], k.shape[2]
+        g = n_q // n_kv
+        qg = q.reshape(B, Sq, n_kv, g, d)
+        scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+        logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k).astype(jnp.float32) * scale
+
+        q_pos = jnp.arange(Sq)[:, None]
+        if decoding:
+            q_pos = q_pos + cache_index
+        k_pos = jnp.arange(Skv)[None, :]
+        mask = q_pos >= k_pos
+        logits = jnp.where(mask[None, None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)
+        return out.reshape(B, Sq, n_q, d)
+
+
+class Embedder(nn.Module):
+    """Token embedding with optional stable scaling and tied decode
+    (ref core/model.py:1618 embedding handling)."""
+
+    config: Config
+    dtype: Dtype = jnp.bfloat16
+
+    def setup(self):
+        cfg = self.config
+        self.embedding = self.param(
+            "embedding",
+            nn.with_logical_partitioning(
+                default_init(cfg.init_std), ("vocab", "embed")
+            ),
+            (cfg.vocab_size, cfg.hidden_size),
+            jnp.float32,
+        )
+
+    def encode(self, tokens: jax.Array) -> jax.Array:
+        x = jnp.take(self.embedding, tokens, axis=0).astype(self.dtype)
+        if self.config.use_stable_embedding:
+            x = x * jnp.sqrt(float(self.config.hidden_size)).astype(self.dtype)
+        return x
+
+    def decode(self, x: jax.Array) -> jax.Array:
+        # fp32 logits for a numerically stable softmax/CE.
+        return jnp.einsum(
+            "bsd,vd->bsv", x.astype(jnp.float32), self.embedding.astype(jnp.float32)
+        )

@@ -1,0 +1,179 @@
+"""Mixture-of-Experts layer, TPU-first.
+
+Covers the reference MoE (ref: Src/Main_Scripts/core/model.py:1090 MoEFFNLayer,
+:1200 _pytorch_routing, :1244 _compute_auxiliary_loss; CUDA dispatch in
+core/moe_cuda_wrapper.py + ColossalAI moe_cuda_kernel.cu). The reference loops
+over experts with `index_add_` (a scatter — fine on GPU, hostile to XLA). Here
+dispatch/combine are one-hot einsums (GShard/Switch style): everything is a
+static-shape matmul that tiles onto the MXU, and sharding the expert dimension
+over the 'expert' mesh axis makes XLA insert the all-to-all on ICI — the
+TPU-native replacement for the reference's NCCL expert-parallel path.
+
+Capacity-factor semantics: each expert processes at most
+C = ceil(cf * S * k / E) tokens per sequence-group; overflow tokens fall back
+to the residual stream (tracked as drop_rate, a headline metric in
+BASELINE.json). Aux losses: Switch load-balance (f·P·E) and router z-loss.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from luminaai_tpu.config import Config
+from luminaai_tpu.models.layers import default_init
+
+Dtype = Any
+
+
+def _top_k_routing(
+    router_probs: jax.Array, top_k: int, capacity: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Greedy top-k assignment with per-expert capacity.
+
+    router_probs: [G, S, E] softmax probabilities.
+    Returns:
+      dispatch: [G, S, E, C] one-hot dispatch mask
+      combine:  [G, S, E, C] combine weights (renormalized top-k probs)
+      dropped:  [G, S] 1.0 where a token lost at least one of its k slots
+    """
+    G, S, E = router_probs.shape
+    probs = router_probs
+    dispatch = jnp.zeros((G, S, E, capacity), dtype=router_probs.dtype)
+    combine = jnp.zeros((G, S, E, capacity), dtype=router_probs.dtype)
+
+    # Renormalization denominator over the k selected experts (ref :1200
+    # renormalizes top-k probs to sum to 1).
+    topk_vals = jax.lax.top_k(probs, top_k)[0]
+    denom = topk_vals.sum(-1, keepdims=True) + 1e-9
+
+    expert_count = jnp.zeros((G, E), dtype=jnp.int32)
+    masked = probs
+    drops = jnp.zeros((G, S), dtype=router_probs.dtype)
+    for _ in range(top_k):
+        choice = jnp.argmax(masked, axis=-1)  # [G, S]
+        onehot = jax.nn.one_hot(choice, E, dtype=probs.dtype)  # [G, S, E]
+        # Position of each token within its chosen expert's buffer: running
+        # count of earlier tokens (in sequence order) routed to that expert.
+        pos_in_expert = (
+            jnp.cumsum(onehot, axis=1) - onehot + expert_count[:, None, :]
+        )  # [G, S, E]
+        pos = jnp.einsum("gse,gse->gs", pos_in_expert, onehot)
+        within = pos < capacity
+        gate = jnp.take_along_axis(probs, choice[..., None], axis=-1)[..., 0] / denom[..., 0]
+        slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=probs.dtype)
+        keep = (within.astype(probs.dtype))[..., None, None]
+        contrib = onehot[..., None] * slot[:, :, None, :] * keep
+        dispatch = dispatch + contrib
+        combine = combine + contrib * gate[..., None, None]
+        drops = drops + (1.0 - within.astype(probs.dtype))
+        expert_count = expert_count + jnp.einsum(
+            "gse,gs->ge", onehot, within.astype(probs.dtype)
+        ).astype(jnp.int32)
+        masked = masked * (1.0 - onehot)  # exclude chosen expert next round
+
+    return dispatch, combine, jnp.clip(drops, 0.0, 1.0)
+
+
+class MoELayer(nn.Module):
+    """Top-k routed expert FFN with capacity-based einsum dispatch.
+
+    Expert weights carry a leading E axis sharded over the 'expert' mesh axis;
+    dispatched activations are sharding-constrained so XLA emits all-to-alls
+    (expert parallelism) instead of gathering weights.
+    """
+
+    config: Config
+    dtype: Dtype = jnp.bfloat16
+    # Static so nn.remat of the enclosing block never traces it.
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        cfg = self.config
+        deterministic = self.deterministic
+        G, S, H = x.shape
+        E, k = cfg.num_experts, cfg.moe_top_k
+        F = cfg.intermediate_size
+        capacity = max(1, int(cfg.capacity_factor * S * k / E))
+        # Round capacity to a multiple of 8 (fp32 sublane) when big enough —
+        # keeps the [E, G, C, H] buffers tileable.
+        if capacity >= 8:
+            capacity = ((capacity + 7) // 8) * 8
+
+        wg = self.param(
+            "router",
+            nn.with_logical_partitioning(default_init(0.02), ("embed", None)),
+            (H, E),
+            jnp.float32,
+        )
+        wi = self.param(
+            "wi",
+            nn.with_logical_partitioning(
+                default_init(cfg.init_std), ("expert", "embed", "mlp_fused")
+            ),
+            (E, H, 2 * F),
+            jnp.float32,
+        )
+        wo = self.param(
+            "wo",
+            nn.with_logical_partitioning(
+                default_init(cfg.init_std / jnp.sqrt(2.0)), ("expert", "mlp", "embed")
+            ),
+            (E, F, H),
+            jnp.float32,
+        )
+
+        # --- Routing (fp32 throughout; ref :1200) ---
+        gate_logits = jnp.einsum("gsh,he->gse", x.astype(jnp.float32), wg)
+        gate_logits = gate_logits / cfg.routing_temperature
+        if not deterministic and cfg.routing_noise_std > 0:
+            noise = (
+                jax.random.normal(self.make_rng("routing"), gate_logits.shape)
+                * cfg.routing_noise_std
+            )
+            gate_logits = gate_logits + noise
+        router_probs = jax.nn.softmax(gate_logits, axis=-1)
+
+        dispatch, combine, dropped = _top_k_routing(router_probs, k, capacity)
+        dispatch = dispatch.astype(self.dtype)
+        combine = combine.astype(self.dtype)
+
+        # --- Dispatch → expert FFN → combine (all einsums) ---
+        expert_in = jnp.einsum("gsec,gsh->egch", dispatch, x)
+        expert_in = nn.with_logical_constraint(
+            expert_in, ("expert", "activation_exp_batch", None, None)
+        )
+        fused = jnp.einsum("egch,ehf->egcf", expert_in, wi.astype(self.dtype))
+        gate_act, up = jnp.split(fused, 2, axis=-1)
+        act = nn.silu(gate_act) * up
+        expert_out = jnp.einsum("egcf,efh->egch", act, wo.astype(self.dtype))
+        expert_out = nn.with_logical_constraint(
+            expert_out, ("expert", "activation_exp_batch", None, None)
+        )
+        out = jnp.einsum("gsec,egch->gsh", combine, expert_out)
+        if cfg.expert_output_scaling != 1.0:
+            out = out * cfg.expert_output_scaling
+
+        # --- Aux losses + stats (ref :1244) ---
+        # f_e: fraction of tokens whose slot went to expert e; P_e: mean prob.
+        tokens_per_expert = jnp.einsum("gsec->e", dispatch.astype(jnp.float32))
+        f = tokens_per_expert / (G * S * k + 1e-9)
+        p = router_probs.mean(axis=(0, 1))
+        aux_loss = jnp.clip(
+            jnp.sum(f * p) * E * cfg.load_balancing_weight, max=1.0
+        )
+        z_loss = (
+            jnp.mean(jax.nn.logsumexp(gate_logits, axis=-1) ** 2)
+            * cfg.router_z_loss_weight
+        )
+        metrics = {
+            "moe_aux_loss": aux_loss,
+            "moe_z_loss": z_loss,
+            "moe_drop_rate": dropped.mean(),
+            "expert_utilization": f * E,  # 1.0 == perfectly balanced
+        }
+        return out.astype(self.dtype), metrics

@@ -1,0 +1,219 @@
+"""Flagship decoder-only transformer (dense / MoE / MoD / hybrid).
+
+Covers the reference model assembly (ref: Src/Main_Scripts/core/model.py:1487
+TransformerBlock, :1545 _should_use_moe, :1618 DeepSeekTransformer) re-designed
+for XLA: pre-norm blocks, per-layer MoE placement patterns, MoD-wrapped dense
+FFNs in hybrid mode, `jax.checkpoint` rematerialization instead of
+torch.utils.checkpoint, and logical sharding constraints on the residual
+stream. Static shapes throughout; decode path uses a preallocated KV cache
+updated with `lax.dynamic_update_slice`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from luminaai_tpu.config import Config
+from luminaai_tpu.models.layers import Embedder, GQAttention, RMSNorm, SwiGLU
+from luminaai_tpu.models.mod import MoDRouter, apply_mod
+from luminaai_tpu.models.moe import MoELayer
+
+Dtype = Any
+
+REMAT_POLICIES = {
+    "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
+    "dots_saveable": jax.checkpoint_policies.dots_saveable,
+    "full": None,
+}
+
+
+class TransformerBlock(nn.Module):
+    """Pre-norm block: x + attn(norm(x)); x + ffn(norm(x)).
+
+    FFN is one of: dense SwiGLU, MoE (per `config.is_moe_layer`), or
+    MoD-gated SwiGLU on dense layers in hybrid mode (ref core/model.py:1304).
+    """
+
+    config: Config
+    layer_idx: int
+    dtype: Dtype = jnp.bfloat16
+    # Static (module attribute, not call arg) so nn.remat never traces it.
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,
+        *,
+        positions: Optional[jax.Array] = None,
+        kv_cache: Optional[Tuple[jax.Array, jax.Array]] = None,
+        cache_index: Optional[jax.Array] = None,
+    ):
+        cfg = self.config
+        deterministic = self.deterministic
+        metrics: Dict[str, jax.Array] = {}
+
+        h, new_cache = GQAttention(cfg, dtype=self.dtype, name="attention")(
+            RMSNorm(cfg.rms_norm_eps, dtype=self.dtype, name="attn_norm")(x),
+            positions=positions,
+            kv_cache=kv_cache,
+            cache_index=cache_index,
+        )
+        x = x + h
+        x = nn.with_logical_constraint(
+            x, ("activation_batch", "activation_length", "activation_embed")
+        )
+
+        y = RMSNorm(cfg.rms_norm_eps, dtype=self.dtype, name="ffn_norm")(x)
+        if cfg.is_moe_layer(self.layer_idx):
+            ffn_out, moe_metrics = MoELayer(
+                cfg, dtype=self.dtype, deterministic=deterministic, name="moe"
+            )(y)
+            metrics.update(moe_metrics)
+        elif cfg.use_mod and kv_cache is None:
+            # MoD skip-routing on dense layers (hybrid mode); decode path runs
+            # dense — per-token routing at S=1 has nothing to skip.
+            ffn = SwiGLU(
+                cfg.intermediate_size,
+                dtype=self.dtype,
+                init_std=cfg.init_std,
+                name="ffn",
+            )
+            router = MoDRouter(
+                cfg.mod_capacity_factor,
+                cfg.mod_routing_temperature,
+                dtype=self.dtype,
+                name="mod_router",
+            )
+            ffn_out, mod_metrics = apply_mod(router, ffn, y)
+            metrics.update(mod_metrics)
+        else:
+            ffn_out = SwiGLU(
+                cfg.intermediate_size,
+                dtype=self.dtype,
+                init_std=cfg.init_std,
+                name="ffn",
+            )(y)
+
+        x = x + ffn_out
+        x = nn.with_logical_constraint(
+            x, ("activation_batch", "activation_length", "activation_embed")
+        )
+        return x, new_cache, metrics
+
+
+class LuminaTransformer(nn.Module):
+    """Decoder-only LM with dense/MoE/MoD blocks (ref core/model.py:1618)."""
+
+    config: Config
+
+    @property
+    def dtype(self):
+        return (
+            jnp.bfloat16
+            if "bf16" in self.config.resolve_precision()
+            else jnp.float32
+        )
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids: jax.Array,
+        *,
+        positions: Optional[jax.Array] = None,
+        kv_caches: Optional[List[Tuple[jax.Array, jax.Array]]] = None,
+        cache_index: Optional[jax.Array] = None,
+        deterministic: bool = True,
+    ):
+        cfg = self.config
+        embedder = Embedder(cfg, dtype=self.dtype, name="embedder")
+        x = embedder.encode(input_ids)
+        x = nn.with_logical_constraint(
+            x, ("activation_batch", "activation_length", "activation_embed")
+        )
+
+        decoding = kv_caches is not None
+        block_cls = TransformerBlock
+        if cfg.gradient_checkpointing and not decoding and not self.is_initializing():
+            policy = REMAT_POLICIES.get(cfg.remat_policy)
+            block_cls = nn.remat(
+                TransformerBlock,
+                policy=policy,
+                prevent_cse=False,
+                static_argnums=(),
+            )
+
+        new_caches: List[Tuple[jax.Array, jax.Array]] = []
+        all_metrics: List[Dict[str, jax.Array]] = []
+        for i in range(cfg.num_layers):
+            cache_i = kv_caches[i] if decoding else None
+            x, new_cache, metrics = block_cls(
+                cfg,
+                layer_idx=i,
+                dtype=self.dtype,
+                deterministic=deterministic,
+                name=f"layer_{i}",
+            )(
+                x,
+                positions=positions,
+                kv_cache=cache_i,
+                cache_index=cache_index,
+            )
+            if decoding:
+                new_caches.append(new_cache)
+            if metrics:
+                all_metrics.append(metrics)
+
+        x = RMSNorm(cfg.rms_norm_eps, dtype=self.dtype, name="final_norm")(x)
+        logits = embedder.decode(x)
+        logits = nn.with_logical_constraint(
+            logits, ("activation_batch", "activation_length", "activation_vocab")
+        )
+
+        aux = self._reduce_metrics(all_metrics)
+        if decoding:
+            return logits, new_caches, aux
+        return logits, aux
+
+    def _reduce_metrics(
+        self, all_metrics: List[Dict[str, jax.Array]]
+    ) -> Dict[str, jax.Array]:
+        """Sum aux losses over layers; average diagnostics."""
+        out: Dict[str, jax.Array] = {"aux_loss": jnp.float32(0.0)}
+        if not all_metrics:
+            return out
+        keys = set().union(*[m.keys() for m in all_metrics])
+        for key in keys:
+            vals = [m[key] for m in all_metrics if key in m]
+            stacked = jnp.stack(vals)
+            if key.endswith("_loss"):
+                out[key] = stacked.sum()
+                out["aux_loss"] = out["aux_loss"] + out[key]
+            else:
+                out[key] = stacked.mean(axis=0)
+        return out
+
+    # -- decode cache (ref Chat.py:346 GenerationEngine cache handling) ----
+    def init_cache(
+        self, batch_size: int, max_len: int
+    ) -> List[Tuple[jax.Array, jax.Array]]:
+        cfg = self.config
+        d = cfg.head_dim()
+        shape = (batch_size, max_len, cfg.num_kv_heads, d)
+        return [
+            (
+                jnp.zeros(shape, dtype=self.dtype),
+                jnp.zeros(shape, dtype=self.dtype),
+            )
+            for _ in range(cfg.num_layers)
+        ]
+
+
+def count_params(params) -> int:
+    """Total parameter count (ref core/model.py:1975 get_num_params)."""
+    return sum(p.size for p in jax.tree.leaves(params))

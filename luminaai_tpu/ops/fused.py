@@ -1,0 +1,81 @@
+"""Fused loss and gradient ops.
+
+Covers the reference's custom CUDA kernels (ref: Src/Main_Scripts/training/
+cuda_kernels.py:91 FusedLoss, :253 FusedGradClip; ColossalAI fused softmax /
+multi-tensor kernels). On TPU these don't need hand-written kernels for the
+bulk of the win: XLA fuses the masked weighted cross-entropy chain into the
+logit matmul epilogue. What matters is the formulation — single logsumexp
+pass, no [B, S, V] one-hot materialization, fp32 accumulation — which this
+module provides.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def cross_entropy_loss(
+    logits: jax.Array,
+    labels: jax.Array,
+    loss_mask: Optional[jax.Array] = None,
+    loss_weights: Optional[jax.Array] = None,
+    z_loss_weight: float = 0.0,
+    label_smoothing: float = 0.0,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Weighted masked CE (ref cuda_kernels.py:91 FusedLoss semantics).
+
+    logits: [B, S, V] (fp32 recommended); labels: [B, S] — already shifted by
+    the caller. loss_mask zeroes padding; loss_weights carries the
+    assistant_loss_weight per-token emphasis (ref core/dataset.py loss masks).
+    Gathers the label logit instead of building a one-hot [B,S,V] tensor.
+    """
+    logits = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(logits, axis=-1)  # [B, S]
+    label_logit = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    nll = lse - label_logit
+    if label_smoothing > 0.0:
+        smooth = lse - jnp.mean(logits, axis=-1)
+        nll = (1.0 - label_smoothing) * nll + label_smoothing * smooth
+
+    weights = jnp.ones_like(nll)
+    if loss_mask is not None:
+        weights = weights * loss_mask.astype(jnp.float32)
+    if loss_weights is not None:
+        weights = weights * loss_weights.astype(jnp.float32)
+
+    denom = jnp.maximum(weights.sum(), 1.0)
+    loss = (nll * weights).sum() / denom
+
+    metrics = {
+        "ce_loss": loss,
+        "perplexity": jnp.exp(jnp.clip(loss, a_max=20.0)),
+        "tokens_in_loss": (weights > 0).sum().astype(jnp.float32),
+    }
+    if z_loss_weight > 0.0:
+        mask = weights > 0
+        z = (jnp.square(lse) * mask).sum() / denom * z_loss_weight
+        loss = loss + z
+        metrics["z_loss"] = z
+    metrics["total_loss"] = loss
+    return loss, metrics
+
+
+def global_norm(grads) -> jax.Array:
+    """Global L2 norm over a pytree (ref cuda_kernels.py:253 FusedGradClip;
+    the multi-tensor-apply trick is unnecessary under XLA — the tree-wide
+    reduction fuses into one pass)."""
+    leaves = jax.tree.leaves(grads)
+    return jnp.sqrt(
+        sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+    )
+
+
+def clip_by_global_norm(grads, max_norm: float):
+    """Returns (clipped_grads, pre_clip_norm)."""
+    norm = global_norm(grads)
+    scale = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree.map(lambda g: (g * scale).astype(g.dtype), grads), norm

@@ -1,0 +1,25 @@
+"""Test harness: force an 8-device virtual CPU mesh.
+
+The container's sitecustomize registers a tunneled TPU ('axon') backend and
+pins JAX_PLATFORMS=axon; tests must run on a virtual 8-device CPU mesh
+instead (sharding coverage without 8 real chips), so override both before
+any backend is initialized.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _assert_cpu_mesh():
+    devices = jax.devices()
+    assert devices[0].platform == "cpu" and len(devices) == 8, devices

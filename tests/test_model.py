@@ -1,0 +1,227 @@
+"""Model unit tests (mirrors ref Src/tests/test_model.py strategy)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from luminaai_tpu.config import Config, ConfigPresets
+from luminaai_tpu.models.layers import RMSNorm, SwiGLU, apply_rope, rope_frequencies
+from luminaai_tpu.models.transformer import LuminaTransformer, count_params
+
+
+def tiny_config(**kw) -> Config:
+    base = dict(
+        vocab_size=256,
+        hidden_size=64,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        seq_length=64,
+        intermediate_size=128,
+        use_moe=False,
+        use_mod=False,
+        gradient_checkpointing=False,
+        use_flash_attention=False,
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+@pytest.fixture
+def rng():
+    return jax.random.PRNGKey(0)
+
+
+class TestRMSNorm:
+    def test_normalizes(self, rng):
+        x = jax.random.normal(rng, (2, 8, 64)) * 10.0
+        norm = RMSNorm(dtype=jnp.float32)
+        y, _ = norm.init_with_output(rng, x)
+        rms = jnp.sqrt(jnp.mean(y**2, axis=-1))
+        assert jnp.allclose(rms, 1.0, atol=1e-3)
+
+    def test_dtype(self, rng):
+        x = jax.random.normal(rng, (2, 8, 64), jnp.bfloat16)
+        y, variables = RMSNorm(dtype=jnp.bfloat16).init_with_output(rng, x)
+        assert y.dtype == jnp.bfloat16
+        # params stay fp32 (mixed precision policy); unbox sharding metadata
+        from flax.linen import meta
+
+        scale = meta.unbox(variables["params"])["scale"]
+        assert scale.dtype == jnp.float32
+
+
+class TestRoPE:
+    def test_rotation_preserves_norm(self, rng):
+        cos, sin = rope_frequencies(64, 128)
+        x = jax.random.normal(rng, (1, 128, 2, 64))
+        y = apply_rope(x, cos, sin)
+        assert jnp.allclose(
+            jnp.linalg.norm(x, axis=-1), jnp.linalg.norm(y, axis=-1), atol=1e-4
+        )
+
+    def test_position_zero_identity(self, rng):
+        cos, sin = rope_frequencies(64, 16)
+        x = jax.random.normal(rng, (1, 1, 1, 64))
+        y = apply_rope(x, cos, sin, positions=jnp.zeros((1, 1), jnp.int32))
+        assert jnp.allclose(x, y, atol=1e-6)
+
+    def test_relative_property(self, rng):
+        # <R(p)q, R(p+k)k> depends only on offset k: shift both positions.
+        d = 64
+        cos, sin = rope_frequencies(d, 256)
+        q = jax.random.normal(rng, (1, 1, 1, d))
+        k = jax.random.normal(jax.random.fold_in(rng, 1), (1, 1, 1, d))
+        def dot_at(p0, p1):
+            qp = apply_rope(q, cos, sin, jnp.array([[p0]]))
+            kp = apply_rope(k, cos, sin, jnp.array([[p1]]))
+            return float(jnp.sum(qp * kp))
+        assert dot_at(3, 7) == pytest.approx(dot_at(100, 104), abs=1e-3)
+
+
+class TestSwiGLU:
+    def test_shape_and_grad(self, rng):
+        x = jax.random.normal(rng, (2, 8, 64), jnp.float32)
+        mod = SwiGLU(intermediate_size=128, dtype=jnp.float32)
+        y, variables = mod.init_with_output(rng, x)
+        assert y.shape == x.shape
+        g = jax.grad(lambda p: mod.apply({"params": p}, x).sum())(variables["params"])
+        assert all(jnp.isfinite(v).all() for v in jax.tree.leaves(g))
+
+
+class TestTransformer:
+    @pytest.mark.parametrize(
+        "kw",
+        [
+            {},
+            {"use_moe": True, "num_experts": 4, "moe_top_k": 2},
+            {"use_mod": True, "mod_capacity_factor": 0.5},
+            {
+                "use_moe": True,
+                "use_mod": True,
+                "num_experts": 4,
+                "moe_pattern": "sandwich",
+                "dense_start_layers": 1,
+                "dense_end_layers": 0,
+                "num_layers": 3,
+            },
+        ],
+        ids=["dense", "moe", "mod", "hybrid"],
+    )
+    def test_forward_backward(self, rng, kw):
+        cfg = tiny_config(**kw)
+        model = LuminaTransformer(cfg)
+        ids = jax.random.randint(rng, (2, cfg.seq_length), 0, cfg.vocab_size)
+        variables = model.init({"params": rng, "routing": rng}, ids)
+        logits, aux = model.apply(
+            variables, ids, deterministic=False, rngs={"routing": rng}
+        )
+        assert logits.shape == (2, cfg.seq_length, cfg.vocab_size)
+        assert logits.dtype == jnp.float32
+        assert jnp.isfinite(logits).all()
+        assert jnp.isfinite(aux["aux_loss"])
+
+        def loss_fn(params):
+            lg, aux = model.apply(
+                {"params": params}, ids, deterministic=False, rngs={"routing": rng}
+            )
+            return lg.astype(jnp.float32).mean() + aux["aux_loss"]
+
+        grads = jax.grad(loss_fn)(variables["params"])
+        assert all(jnp.isfinite(g).all() for g in jax.tree.leaves(grads))
+
+    def test_remat_matches_no_remat(self, rng):
+        cfg = tiny_config()
+        ids = jax.random.randint(rng, (2, cfg.seq_length), 0, cfg.vocab_size)
+        outs = []
+        variables = None
+        for remat in (False, True):
+            c = dataclasses.replace(cfg, gradient_checkpointing=remat)
+            model = LuminaTransformer(c)
+            if variables is None:
+                variables = model.init({"params": rng}, ids)
+            logits, _ = model.apply(variables, ids)
+            outs.append(logits)
+        assert jnp.allclose(outs[0], outs[1], atol=1e-5)
+
+    def test_param_count_matches_estimate(self, rng):
+        cfg = tiny_config(use_moe=True, num_experts=4)
+        model = LuminaTransformer(cfg)
+        ids = jnp.zeros((1, 8), jnp.int32)
+        variables = model.init({"params": rng, "routing": rng}, ids)
+        actual = count_params(variables["params"])
+        est = cfg.estimate_parameters()
+        assert abs(actual - est) / actual < 0.02, (actual, est)
+
+    def test_causality(self, rng):
+        """Changing a future token must not change past logits."""
+        cfg = tiny_config()
+        model = LuminaTransformer(cfg)
+        ids = jax.random.randint(rng, (1, cfg.seq_length), 0, cfg.vocab_size)
+        variables = model.init({"params": rng}, ids)
+        logits1, _ = model.apply(variables, ids)
+        ids2 = ids.at[0, -1].set((ids[0, -1] + 1) % cfg.vocab_size)
+        logits2, _ = model.apply(variables, ids2)
+        assert jnp.allclose(logits1[0, :-1], logits2[0, :-1], atol=1e-5)
+
+
+class TestKVCache:
+    def test_incremental_decode_matches_full(self, rng):
+        cfg = tiny_config()
+        model = LuminaTransformer(cfg)
+        S = 16
+        ids = jax.random.randint(rng, (1, S), 0, cfg.vocab_size)
+        variables = model.init({"params": rng}, ids)
+        full_logits, _ = model.apply(variables, ids)
+
+        caches = model.init_cache(1, S)
+        step_logits = []
+        for t in range(S):
+            lg, caches, _ = model.apply(
+                variables,
+                ids[:, t : t + 1],
+                positions=jnp.array([[t]]),
+                kv_caches=caches,
+                cache_index=t,
+            )
+            step_logits.append(lg[:, 0])
+        inc = jnp.stack(step_logits, axis=1)
+        assert jnp.allclose(full_logits, inc, atol=2e-2), (
+            float(jnp.abs(full_logits - inc).max())
+        )
+
+
+class TestConfig:
+    def test_presets_valid(self):
+        for name in ConfigPresets.available():
+            cfg = ConfigPresets.get(name)
+            assert cfg.estimate_parameters() > 0
+
+    def test_moe_patterns(self):
+        cfg = tiny_config(
+            use_moe=True, num_layers=6, moe_pattern="every_3rd", num_experts=4
+        )
+        assert [cfg.is_moe_layer(i) for i in range(6)] == [
+            False, False, True, False, False, True,
+        ]
+        cfg2 = dataclasses.replace(cfg, moe_pattern="sandwich", dense_start_layers=2, dense_end_layers=2)
+        assert [cfg2.is_moe_layer(i) for i in range(6)] == [
+            False, False, True, True, False, False,
+        ]
+
+    def test_validation_errors(self):
+        with pytest.raises(AssertionError):
+            tiny_config(hidden_size=65)
+        with pytest.raises(AssertionError):
+            tiny_config(use_moe=True, moe_top_k=9, num_experts=4)
+        with pytest.raises(AssertionError):
+            tiny_config(use_mod=True, mod_capacity_factor=1.5)
+
+    def test_roundtrip(self, tmp_path):
+        cfg = ConfigPresets.debug()
+        p = str(tmp_path / "c.yaml")
+        cfg.save(p)
+        cfg2 = Config.load(p)
+        assert cfg.to_dict() == cfg2.to_dict()

@@ -1,0 +1,111 @@
+"""Ops tests: flash attention vs XLA reference, fused loss semantics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from luminaai_tpu.ops.flash_attention import flash_attention
+from luminaai_tpu.ops.fused import clip_by_global_norm, cross_entropy_loss, global_norm
+
+
+def ref_attention(q, k, v, causal=True):
+    B, S, Hq, D = q.shape
+    g = Hq // k.shape[2]
+    kk = jnp.repeat(k, g, axis=2)
+    vv = jnp.repeat(v, g, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, kk) / jnp.sqrt(D)
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, -1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, vv)
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("hkv", [4, 2, 1], ids=["mha", "gqa", "mqa"])
+    def test_forward_matches_reference(self, hkv):
+        B, S, Hq, D = 2, 256, 4, 128
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(ks[0], (B, S, Hq, D), jnp.float32)
+        k = jax.random.normal(ks[1], (B, S, hkv, D), jnp.float32)
+        v = jax.random.normal(ks[2], (B, S, hkv, D), jnp.float32)
+        out = flash_attention(q, k, v, block_q=128, block_kv=128)
+        ref = ref_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_backward_matches_reference(self):
+        B, S, Hq, Hkv, D = 1, 256, 2, 1, 128
+        ks = jax.random.split(jax.random.PRNGKey(1), 3)
+        q = jax.random.normal(ks[0], (B, S, Hq, D), jnp.float32)
+        k = jax.random.normal(ks[1], (B, S, Hkv, D), jnp.float32)
+        v = jax.random.normal(ks[2], (B, S, Hkv, D), jnp.float32)
+        f = lambda q, k, v: (flash_attention(q, k, v, block_q=128, block_kv=128) ** 2).sum()
+        r = lambda q, k, v: (ref_attention(q, k, v) ** 2).sum()
+        gf = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(r, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+
+    def test_non_causal(self):
+        B, S, H, D = 1, 128, 2, 128
+        ks = jax.random.split(jax.random.PRNGKey(2), 3)
+        q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.float32) for kk in ks)
+        out = flash_attention(q, k, v, causal=False, block_q=128, block_kv=128)
+        ref = ref_attention(q, k, v, causal=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+class TestCrossEntropy:
+    def test_matches_naive(self):
+        rng = jax.random.PRNGKey(0)
+        logits = jax.random.normal(rng, (2, 8, 16))
+        labels = jax.random.randint(rng, (2, 8), 0, 16)
+        loss, _ = cross_entropy_loss(logits, labels)
+        naive = -jnp.take_along_axis(
+            jax.nn.log_softmax(logits, -1), labels[..., None], -1
+        ).mean()
+        assert float(loss) == pytest.approx(float(naive), abs=1e-5)
+
+    def test_mask_excludes_tokens(self):
+        rng = jax.random.PRNGKey(0)
+        logits = jax.random.normal(rng, (1, 4, 8))
+        labels = jnp.array([[1, 2, 3, 4]])
+        mask = jnp.array([[1.0, 1.0, 0.0, 0.0]])
+        loss_m, m = cross_entropy_loss(logits, labels, loss_mask=mask)
+        loss_half, _ = cross_entropy_loss(logits[:, :2], labels[:, :2])
+        assert float(loss_m) == pytest.approx(float(loss_half), abs=1e-5)
+        assert float(m["tokens_in_loss"]) == 2.0
+
+    def test_assistant_weighting(self):
+        rng = jax.random.PRNGKey(0)
+        logits = jax.random.normal(rng, (1, 4, 8))
+        labels = jnp.array([[1, 2, 3, 4]])
+        w = jnp.array([[1.0, 1.0, 1.5, 1.5]])
+        loss_w, _ = cross_entropy_loss(logits, labels, loss_weights=w)
+        # weighted mean, not plain mean
+        nll = -jnp.take_along_axis(jax.nn.log_softmax(logits, -1), labels[..., None], -1)[..., 0]
+        expected = float((nll * w).sum() / w.sum())
+        assert float(loss_w) == pytest.approx(expected, abs=1e-5)
+
+    def test_z_loss_positive(self):
+        rng = jax.random.PRNGKey(0)
+        logits = jax.random.normal(rng, (1, 4, 8)) * 5
+        labels = jnp.zeros((1, 4), jnp.int32)
+        loss_z, m = cross_entropy_loss(logits, labels, z_loss_weight=1e-2)
+        loss, _ = cross_entropy_loss(logits, labels)
+        assert float(loss_z) > float(loss)
+        assert float(m["z_loss"]) > 0
+
+
+class TestGradClip:
+    def test_clip(self):
+        grads = {"a": jnp.full((4,), 10.0), "b": jnp.full((3,), -10.0)}
+        clipped, norm = clip_by_global_norm(grads, max_norm=1.0)
+        assert float(norm) == pytest.approx(np.sqrt(700.0), rel=1e-5)
+        assert float(global_norm(clipped)) == pytest.approx(1.0, rel=1e-4)
+
+    def test_no_clip_below_threshold(self):
+        grads = {"a": jnp.array([0.1, 0.1])}
+        clipped, norm = clip_by_global_norm(grads, max_norm=1.0)
+        np.testing.assert_allclose(np.asarray(clipped["a"]), [0.1, 0.1], rtol=1e-5)
