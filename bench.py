@@ -1,0 +1,91 @@
+"""Benchmark harness (driver contract: ONE JSON line on stdout).
+
+North-star metric (SURVEY.md §6 / BASELINE.json): training tokens/sec/chip
+on the 8-expert top-2 MoE config (capacity 1.25, aux 0.01), bf16, full train
+step (fwd + bwd + optimizer). vs_baseline compares against the reference's
+headline debug-MoE figure (59.5k tok/s, BENCHMARKS.md consumer-GPU number —
+the only published absolute throughput for this model family).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+REF_MOE_TOKENS_PER_SEC = 59_500.0
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from luminaai_tpu.config import Config
+    from luminaai_tpu.models.transformer import LuminaTransformer
+    from luminaai_tpu.parallel.mesh import build_mesh
+    from luminaai_tpu.parallel.sharding import init_sharded_state
+    from luminaai_tpu.parallel.train_step import make_train_step
+    from luminaai_tpu.training.optimizer import make_optimizer, make_schedule
+
+    n_chips = jax.device_count()
+    cfg = Config(
+        vocab_size=32768,
+        hidden_size=512,
+        num_layers=8,
+        num_heads=8,
+        num_kv_heads=4,
+        seq_length=1024,
+        batch_size=16 * n_chips,
+        use_moe=True,
+        num_experts=8,
+        moe_top_k=2,
+        capacity_factor=1.25,
+        load_balancing_weight=0.01,
+        precision="bf16",
+        gradient_checkpointing=False,
+    )
+    model = LuminaTransformer(cfg)
+    schedule = make_schedule(cfg, 1000)
+    tx = make_optimizer(cfg, 1000, schedule)
+    mesh = build_mesh(cfg)
+    state, shardings = init_sharded_state(cfg, model, tx, mesh, jax.random.key(0))
+    step = make_train_step(cfg, model, shardings, mesh, schedule)
+
+    ids = np.random.RandomState(0).randint(
+        1, cfg.vocab_size, size=(cfg.batch_size, cfg.seq_length)
+    )
+    batch = {"input_ids": jnp.asarray(ids, jnp.int32)}
+
+    # Warmup: compile + one executed step.
+    for _ in range(2):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+
+    steps = 20
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens = steps * cfg.batch_size * cfg.seq_length
+    tps_chip = tokens / dt / n_chips
+    result = {
+        "metric": "train_tokens_per_sec_per_chip_moe8x2",
+        "value": round(tps_chip, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(tps_chip / REF_MOE_TOKENS_PER_SEC, 3),
+        "extras": {
+            "chips": n_chips,
+            "loss": round(float(metrics["loss"]), 4),
+            "moe_drop_rate": round(float(metrics.get("moe_drop_rate", 0.0)), 4),
+            "step_ms": round(dt / steps * 1e3, 2),
+            "platform": jax.devices()[0].platform,
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -101,7 +101,9 @@ class Config:
     label_smoothing: float = 0.0
 
     # --- Parallelism (replaces ref DeepSpeed/FSDP/ColossalAI group) ---
-    mesh_axes: tuple = ("data", "fsdp", "expert", "tensor", "sequence")
+    # Axis order = physical torus placement: trailing axes land on the
+    # innermost ICI ring, so the chattiest collectives (tensor) go last.
+    mesh_axes: tuple = ("data", "fsdp", "expert", "sequence", "tensor")
     data_parallel_size: int = -1  # -1 = infer remaining devices
     fsdp_parallel_size: int = 1
     expert_parallel_size: int = 1

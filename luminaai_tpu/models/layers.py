@@ -233,8 +233,8 @@ class GQAttention(nn.Module):
             cfg.use_flash_attention
             and kv_cache is None
             and S >= 128
-            and d % 128 == 0
-            and S % cfg.flash_block_q == 0
+            and d % 64 == 0  # Mosaic pads 64→128 lanes; <64 not worth it
+            and S % min(cfg.flash_block_q, S) == 0
         )
         if use_flash:
             from luminaai_tpu.ops.flash_attention import flash_attention

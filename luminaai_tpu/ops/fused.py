@@ -52,7 +52,7 @@ def cross_entropy_loss(
 
     metrics = {
         "ce_loss": loss,
-        "perplexity": jnp.exp(jnp.clip(loss, a_max=20.0)),
+        "perplexity": jnp.exp(jnp.clip(loss, max=20.0)),
         "tokens_in_loss": (weights > 0).sum().astype(jnp.float32),
     }
     if z_loss_weight > 0.0:

@@ -1,0 +1,217 @@
+"""pjit train/eval step factory with in-jit gradient accumulation.
+
+Replaces the reference's backend train loops (ref: Src/Main_Scripts/core/
+backend/backend_deepspeed.py engine.step(), backend_fsdp.py:44,
+training/training_loop.py microbatch loop). Differences, by design:
+
+  - One jit covers forward, backward, accumulation, clip, and optimizer
+    update. The reference crosses the Python boundary per microbatch; here
+    grad accumulation is a `lax.scan` inside the step, so XLA pipelines
+    microbatches without host round-trips.
+  - Parallelism is data-driven: the same traced function runs dp / fsdp /
+    tp / ep / sp depending on the shardings attached to state and batch.
+    XLA inserts the gradient psum over the data axis (the reference's
+    all-reduce), reduce-scatter/all-gather for fsdp (ZeRO-3), and
+    all-to-alls for expert parallelism.
+  - The TrainState buffer is donated: params/opt-state update in place in
+    HBM, halving peak optimizer memory vs a copy.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from luminaai_tpu.config import Config
+from luminaai_tpu.ops.fused import (
+    clip_by_global_norm,
+    cross_entropy_loss,
+    global_norm,
+)
+from luminaai_tpu.parallel.sharding import (
+    TrainState,
+    batch_spec,
+    logical_axis_rules,
+)
+
+Batch = Dict[str, jax.Array]
+
+
+def shift_labels(batch: Batch) -> Tuple[jax.Array, jax.Array]:
+    """Next-token labels + validity mask from input_ids.
+
+    (ref core/dataset.py builds shifted labels host-side; doing it in-jit
+    keeps the host pipeline dtype-only.) Last position has no target.
+    """
+    ids = batch["input_ids"]
+    labels = jnp.concatenate(
+        [ids[:, 1:], jnp.zeros_like(ids[:, :1])], axis=1
+    )
+    valid = jnp.concatenate(
+        [
+            jnp.ones_like(ids[:, 1:], dtype=jnp.float32),
+            jnp.zeros_like(ids[:, :1], dtype=jnp.float32),
+        ],
+        axis=1,
+    )
+    return labels, valid
+
+
+def make_loss_fn(config: Config, model) -> Callable:
+    def loss_fn(params, batch: Batch, rng: jax.Array):
+        rngs = {"routing": rng, "dropout": jax.random.fold_in(rng, 1)}
+        logits, aux = model.apply(
+            {"params": params},
+            batch["input_ids"],
+            deterministic=False,
+            rngs=rngs,
+        )
+        labels, valid = shift_labels(batch)
+        loss_mask = batch.get("loss_mask")
+        mask = valid if loss_mask is None else valid * loss_mask
+        loss, metrics = cross_entropy_loss(
+            logits,
+            labels,
+            loss_mask=mask,
+            loss_weights=batch.get("loss_weights"),
+            z_loss_weight=config.z_loss_weight,
+            label_smoothing=config.label_smoothing,
+        )
+        total = loss + aux.get("aux_loss", 0.0)
+        for k, v in aux.items():
+            metrics[k] = v
+        metrics["loss"] = total
+        return total, metrics
+
+    return loss_fn
+
+
+def _accumulate_grads(
+    loss_fn, params, batch: Batch, rng: jax.Array, accum_steps: int
+):
+    """Gradient accumulation via lax.scan over microbatch slices.
+
+    (ref training_loop.py loops microbatches in Python with engine
+    .backward(); here the loop is compiled, grads accumulate in fp32.)
+    """
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    if accum_steps <= 1:
+        (loss, metrics), grads = grad_fn(params, batch, rng)
+        return grads, metrics
+
+    def to_micro(x):
+        return x.reshape(accum_steps, x.shape[0] // accum_steps, *x.shape[1:])
+
+    micro = jax.tree.map(to_micro, batch)
+    acc_grads = jax.tree.map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params
+    )
+
+    def body(acc, xs):
+        mb, step_rng = xs
+        (_, metrics), grads = grad_fn(params, mb, step_rng)
+        acc = jax.tree.map(
+            lambda a, g: a + g.astype(jnp.float32) / accum_steps, acc, grads
+        )
+        return acc, metrics
+
+    rngs = jax.random.split(rng, accum_steps)
+    grads, metrics_stack = jax.lax.scan(body, acc_grads, (micro, rngs))
+    # Count-like metrics sum over microbatches; the rest average.
+    metrics = {
+        k: m.sum(axis=0) if k == "tokens_in_loss" else m.mean(axis=0)
+        for k, m in metrics_stack.items()
+    }
+    return grads, metrics
+
+
+def make_train_step(
+    config: Config,
+    model,
+    state_shardings: TrainState,
+    mesh: Mesh,
+    schedule: Optional[optax.Schedule] = None,
+):
+    """Build the donated, sharded, jitted train step.
+
+    Returns `step(state, batch) -> (state, metrics)`. Call under no special
+    context — mesh and logical rules are bound at trace time here.
+    """
+    loss_fn = make_loss_fn(config, model)
+    accum = config.gradient_accumulation_steps
+    bspec = NamedSharding(mesh, batch_spec())
+
+    def train_step(state: TrainState, batch: Batch):
+        step_rng, new_rng = jax.random.split(state.rng)
+        grads, metrics = _accumulate_grads(
+            loss_fn, state.params, batch, step_rng, accum
+        )
+        if config.grad_clip_norm > 0:
+            grads, grad_norm = clip_by_global_norm(grads, config.grad_clip_norm)
+        else:  # clipping off; still report the norm for monitoring
+            grad_norm = global_norm(grads)
+        new_state = state.apply_gradients(grads).replace(rng=new_rng)
+        metrics["grad_norm"] = grad_norm
+        if schedule is not None:
+            metrics["learning_rate"] = schedule(state.step)
+        return new_state, metrics
+
+    def traced(state, batch):
+        with mesh, nn.logical_axis_rules(logical_axis_rules(config)):
+            return train_step(state, batch)
+
+    jitted = jax.jit(
+        traced,
+        in_shardings=(state_shardings, bspec),
+        out_shardings=(state_shardings, None),
+        donate_argnums=(0,) if config.donate_state else (),
+    )
+
+    def call(state, batch):
+        with mesh:
+            return jitted(state, batch)
+
+    return call
+
+
+def make_eval_step(
+    config: Config, model, state_shardings: TrainState, mesh: Mesh
+):
+    """Forward-only eval step: loss + metrics, deterministic routing."""
+
+    def eval_loss(params, batch: Batch):
+        logits, aux = model.apply(
+            {"params": params}, batch["input_ids"], deterministic=True
+        )
+        labels, valid = shift_labels(batch)
+        loss_mask = batch.get("loss_mask")
+        mask = valid if loss_mask is None else valid * loss_mask
+        loss, metrics = cross_entropy_loss(
+            logits, labels, loss_mask=mask,
+            loss_weights=batch.get("loss_weights"),
+        )
+        for k, v in aux.items():
+            metrics[k] = v
+        metrics["loss"] = loss + aux.get("aux_loss", 0.0)
+        return metrics
+
+    bspec = NamedSharding(mesh, batch_spec())
+
+    def traced(state, batch):
+        with mesh, nn.logical_axis_rules(logical_axis_rules(config)):
+            return eval_loss(state.params, batch)
+
+    jitted = jax.jit(traced, in_shardings=(state_shardings, bspec))
+
+    def call(state, batch):
+        with mesh:
+            return jitted(state, batch)
+
+    return call

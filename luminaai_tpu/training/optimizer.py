@@ -1,0 +1,78 @@
+"""Optimizer and LR-schedule construction.
+
+Covers the reference optimizer setup (ref: Src/Main_Scripts/training/
+trainer.py — AdamW + warmup + {cosine,linear,constant} schedules, min_lr
+floor, weight-decay exclusion for norms/bias) via optax. Adds WSD
+(warmup-stable-decay) since long-horizon pretraining on TPU pods favors it.
+The reference's fused/multi-tensor Adam (ColossalAI cpu_adam, fused_optim)
+is unnecessary: optax's update is a handful of elementwise ops XLA fuses
+into one kernel per parameter shard.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import optax
+
+from luminaai_tpu.config import Config
+
+
+def make_schedule(config: Config, total_steps: int) -> optax.Schedule:
+    """Warmup + decay schedule (ref trainer.py scheduler setup)."""
+    warmup_steps = max(1, int(total_steps * config.warmup_ratio))
+    peak = config.learning_rate
+    floor = min(config.min_lr, peak)
+    if not config.use_lr_scheduler:
+        return optax.constant_schedule(peak)
+
+    warmup = optax.linear_schedule(0.0, peak, warmup_steps)
+    decay_steps = max(1, total_steps - warmup_steps)
+    kind = config.lr_scheduler
+    if kind == "cosine":
+        decay = optax.cosine_decay_schedule(
+            peak, decay_steps, alpha=floor / max(peak, 1e-12)
+        )
+    elif kind == "linear":
+        decay = optax.linear_schedule(peak, floor, decay_steps)
+    elif kind == "constant":
+        decay = optax.constant_schedule(peak)
+    elif kind == "wsd":
+        stable_steps = int(decay_steps * 0.8)
+        decay = optax.join_schedules(
+            [
+                optax.constant_schedule(peak),
+                optax.linear_schedule(peak, floor, decay_steps - stable_steps),
+            ],
+            [stable_steps],
+        )
+    else:  # pragma: no cover - validated by Config
+        raise ValueError(f"unknown scheduler {kind}")
+    return optax.join_schedules([warmup, decay], [warmup_steps])
+
+
+def _decay_mask(params):
+    """Apply weight decay to matrices only — norms/scales/bias excluded
+    (ref trainer.py no_decay param groups)."""
+    import jax
+
+    return jax.tree.map(lambda p: p.ndim >= 2, params)
+
+
+def make_optimizer(
+    config: Config,
+    total_steps: int,
+    schedule: Optional[optax.Schedule] = None,
+) -> optax.GradientTransformation:
+    """AdamW stack. Gradient clipping lives in the train step (it reports
+    the pre-clip norm to monitoring, ref cuda_kernels.py FusedGradClip)."""
+    if schedule is None:
+        schedule = make_schedule(config, total_steps)
+    return optax.adamw(
+        learning_rate=schedule,
+        b1=config.beta1,
+        b2=config.beta2,
+        eps=config.eps,
+        weight_decay=config.weight_decay,
+        mask=_decay_mask,
+    )
