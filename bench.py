@@ -50,7 +50,7 @@ def main() -> None:
     tx = make_optimizer(cfg, 1000, schedule)
     mesh = build_mesh(cfg)
     state, shardings = init_sharded_state(cfg, model, tx, mesh, jax.random.key(0))
-    step = make_train_step(cfg, model, shardings, mesh, schedule)
+    step = make_train_step(cfg, model, shardings, mesh, schedule, tx)
 
     ids = np.random.RandomState(0).randint(
         1, cfg.vocab_size, size=(cfg.batch_size, cfg.seq_length)

@@ -302,6 +302,12 @@ class Config:
         p = self.inference_precision if for_inference else self.precision
         if p == "auto":
             return "bf16" if for_inference else "mixed_bf16"
+        # fp16 is a CUDA legacy (ref GradScaler machinery); TPU MXUs take
+        # bf16 natively with fp32 range, so fp16 modes alias to bf16.
+        if p == "fp16":
+            return "bf16"
+        if p == "mixed_fp16":
+            return "mixed_bf16"
         return p
 
     def total_mesh_size(self) -> int:

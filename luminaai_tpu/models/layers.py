@@ -235,6 +235,7 @@ class GQAttention(nn.Module):
             and S >= 128
             and d % 64 == 0  # Mosaic pads 64→128 lanes; <64 not worth it
             and S % min(cfg.flash_block_q, S) == 0
+            and S % min(cfg.flash_block_kv, S) == 0
         )
         if use_flash:
             from luminaai_tpu.ops.flash_attention import flash_attention

@@ -74,21 +74,23 @@ def logical_axis_rules(config: Optional[Config] = None):
 
 
 class TrainState(struct.PyTreeNode):
-    """Minimal train state: params + optimizer + step + rng.
+    """Minimal train state: params + optimizer state + step + rng.
 
     (ref training/trainer.py keeps these scattered across the Trainer object
     and the DeepSpeed engine; here it is one pytree so the whole update is a
-    single donated jit.)
+    single donated jit.) The optax transform itself is NOT stored — it is
+    closed over by the train step, so the orchestrator can swap optimizers
+    (LR override) without changing the pytree structure the jit was traced
+    with.
     """
 
     step: jax.Array
     params: Any
     opt_state: Any
     rng: jax.Array
-    tx: optax.GradientTransformation = struct.field(pytree_node=False)
 
-    def apply_gradients(self, grads):
-        updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
+    def apply_gradients(self, grads, tx: optax.GradientTransformation):
+        updates, new_opt_state = tx.update(grads, self.opt_state, self.params)
         new_params = optax.apply_updates(self.params, updates)
         return self.replace(
             step=self.step + 1,
@@ -121,7 +123,6 @@ def make_init_fn(config: Config, model, tx):
             params=params,
             opt_state=tx.init(params),
             rng=state_rng,
-            tx=tx,
         )
 
     return init
@@ -191,7 +192,6 @@ def state_shardings(config: Config, model, tx, mesh: Mesh) -> TrainState:
         params=p_shardings,
         opt_state=opt_shardings,
         rng=replicated,
-        tx=tx,
     )
 
 

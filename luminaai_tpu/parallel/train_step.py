@@ -137,12 +137,16 @@ def make_train_step(
     model,
     state_shardings: TrainState,
     mesh: Mesh,
-    schedule: Optional[optax.Schedule] = None,
+    schedule: Optional[optax.Schedule],
+    tx: optax.GradientTransformation,
 ):
     """Build the donated, sharded, jitted train step.
 
     Returns `step(state, batch) -> (state, metrics)`. Call under no special
-    context — mesh and logical rules are bound at trace time here.
+    context — mesh and logical rules are bound at trace time here. `tx` is
+    closed over (not stored in state), so a rebuilt step with a new
+    transform reuses the same TrainState as long as the opt-state structure
+    matches (e.g. LR overrides).
     """
     loss_fn = make_loss_fn(config, model)
     accum = config.gradient_accumulation_steps
@@ -157,7 +161,7 @@ def make_train_step(
             grads, grad_norm = clip_by_global_norm(grads, config.grad_clip_norm)
         else:  # clipping off; still report the norm for monitoring
             grad_norm = global_norm(grads)
-        new_state = state.apply_gradients(grads).replace(rng=new_rng)
+        new_state = state.apply_gradients(grads, tx).replace(rng=new_rng)
         metrics["grad_norm"] = grad_norm
         if schedule is not None:
             metrics["learning_rate"] = schedule(state.step)

@@ -1,0 +1,205 @@
+"""Checkpoint management on orbax (async, multi-host-safe, sharded).
+
+Covers the reference CheckpointManager (ref: Src/Main_Scripts/training/
+checkpoint.py:14 — save/load with optimizer+scheduler state, rotation by
+save_total_limit, best-checkpoint tracking, resume discovery, emergency
+save, history json). Differences by design:
+
+  - orbax writes each param shard from the host that owns it (multi-host
+    safe) and restores directly into the target NamedShardings — no
+    gather-to-host-0 like the reference's torch.save path.
+  - Async save: the train loop keeps stepping while the previous
+    checkpoint flushes (ref blocks the loop on torch.save).
+  - The schedule needs no state: optax schedules are pure functions of
+    `step`, so "scheduler state" is just the step counter.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import shutil
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from luminaai_tpu.config import Config
+
+logger = logging.getLogger(__name__)
+
+
+class CheckpointManager:
+    """Save/restore TrainState with rotation, best-k tracking and resume.
+
+    Layout: <dir>/<step>/ (orbax composite: state + metadata),
+    <dir>/checkpoint_history.json mirrors ref history tracking.
+    """
+
+    def __init__(self, config: Config, checkpoint_dir: str = "checkpoints"):
+        self.config = config
+        self.dir = Path(checkpoint_dir).absolute()
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.history_file = self.dir / "checkpoint_history.json"
+        self.history: List[Dict[str, Any]] = self._load_history()
+        self.best_loss = min(
+            (h["eval_loss"] for h in self.history if h.get("eval_loss") is not None),
+            default=float("inf"),
+        )
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max(1, config.save_total_limit),
+            enable_async_checkpointing=True,
+            best_fn=(lambda m: m.get("eval_loss", float("inf"))),
+            best_mode="min",
+            keep_checkpoints_without_metrics=True,
+        )
+        self._mngr = ocp.CheckpointManager(self.dir, options=options)
+
+    # -- save -----------------------------------------------------------
+    def save(
+        self,
+        state,
+        step: int,
+        metrics: Optional[Dict[str, float]] = None,
+        force: bool = False,
+    ) -> bool:
+        """Async-save train state at `step` (ref checkpoint.py:36)."""
+        metrics = {
+            k: float(v)
+            for k, v in (metrics or {}).items()
+            if np.isscalar(v) or getattr(v, "ndim", 1) == 0
+        }
+        saveable = {"params": state.params, "opt_state": state.opt_state,
+                    "step": state.step, "rng": state.rng}
+        if step in self._mngr.all_steps():
+            if not force:
+                return False  # already checkpointed (periodic duplicate)
+            # force: re-save with fresher metrics (e.g. final eval).
+            self.wait()
+            self._mngr.delete(step)
+        saved = self._mngr.save(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(saveable),
+                metadata=ocp.args.JsonSave(
+                    {
+                        "step": step,
+                        "config": self.config.to_dict(),
+                        "metrics": metrics,
+                        "timestamp": time.time(),
+                    }
+                ),
+            ),
+            metrics=metrics,
+            force=force,
+        )
+        if saved:
+            eval_loss = metrics.get("eval_loss")
+            self.history.append(
+                {"step": step, "eval_loss": eval_loss, "time": time.time()}
+            )
+            if eval_loss is not None and eval_loss < self.best_loss:
+                self.best_loss = eval_loss
+            self._save_history()
+        return saved
+
+    def wait(self) -> None:
+        """Block until pending async saves land (call before exit)."""
+        self._mngr.wait_until_finished()
+
+    # -- restore --------------------------------------------------------
+    def restore(self, state, step: Optional[int] = None):
+        """Restore into the sharding/structure of `state` (abstract or
+        concrete). Returns the restored TrainState-shaped tree."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.dir}")
+        target = {"params": state.params, "opt_state": state.opt_state,
+                  "step": state.step, "rng": state.rng}
+        restored = self._mngr.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(target)
+            ),
+        )["state"]
+        return state.replace(
+            params=restored["params"],
+            opt_state=restored["opt_state"],
+            step=restored["step"],
+            rng=restored["rng"],
+        )
+
+    def load_metadata(self, step: Optional[int] = None) -> Dict[str, Any]:
+        if step is None:
+            step = self.latest_step()
+        return self._mngr.restore(
+            step, args=ocp.args.Composite(metadata=ocp.args.JsonRestore())
+        )["metadata"]
+
+    # -- discovery (ref checkpoint.py:178,187,341) -----------------------
+    def latest_step(self) -> Optional[int]:
+        return self._mngr.latest_step()
+
+    def best_step(self) -> Optional[int]:
+        return self._mngr.best_step()
+
+    def all_steps(self) -> List[int]:
+        return sorted(self._mngr.all_steps())
+
+    def get_resume_step(self) -> Optional[int]:
+        """Auto-resume point if enabled (ref get_resume_path)."""
+        if not self.config.auto_resume:
+            return None
+        return self.latest_step()
+
+    # -- maintenance ----------------------------------------------------
+    def delete(self, step: int) -> bool:
+        try:
+            self._mngr.delete(step)
+            return True
+        except Exception as e:  # pragma: no cover
+            logger.warning("delete of step %d failed: %s", step, e)
+            return False
+
+    def create_backup(self, backup_dir: Optional[str] = None) -> str:
+        """Copy the latest checkpoint aside (ref checkpoint.py:219)."""
+        self.wait()
+        step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError("nothing to back up")
+        dest_root = Path(backup_dir or (self.dir.parent / "backups"))
+        dest = dest_root / f"{self.dir.name}_step{step}_{int(time.time())}"
+        shutil.copytree(self.dir / str(step), dest)
+        return str(dest)
+
+    def emergency_save(self, state, step: int, reason: str = "") -> bool:
+        """Best-effort synchronous save on failure (ref checkpoint.py:355)."""
+        try:
+            ok = self.save(state, step, metrics={"emergency": 1.0}, force=True)
+            self.wait()
+            logger.warning("emergency checkpoint at step %d (%s)", step, reason)
+            return ok
+        except Exception as e:  # pragma: no cover
+            logger.error("emergency save failed: %s", e)
+            return False
+
+    # -- history --------------------------------------------------------
+    def _load_history(self) -> List[Dict[str, Any]]:
+        if self.history_file.exists():
+            try:
+                return json.loads(self.history_file.read_text())
+            except Exception:  # pragma: no cover
+                return []
+        return []
+
+    def _save_history(self) -> None:
+        if jax.process_index() == 0:
+            self.history_file.write_text(json.dumps(self.history, indent=1))
+
+    def close(self) -> None:
+        self.wait()
+        self._mngr.close()

@@ -1,0 +1,323 @@
+"""Training loop orchestration.
+
+Covers the reference EnhancedConversationTrainer (ref: Src/Main_Scripts/
+training/trainer.py:985 — epoch/step loops, grad accumulation, periodic
+eval/save, early stopping, LR adjustment hooks, throughput + memory
+tracking, OOM fallback) and training_loop.py. TPU-shape differences:
+
+  - The step itself (fwd+bwd+accum+clip+update) is one donated pjit call
+    built by `parallel.train_step`; the Python loop only feeds batches and
+    reads scalars. Grad accumulation lives inside the jit (lax.scan), not
+    in this loop like the reference's microbatch Python loop.
+  - Async checkpointing (orbax) instead of blocking torch.save.
+  - Metrics arrive as device scalars; conversion to float happens once per
+    log interval so the loop never forces a sync per step.
+  - Adaptive interventions (LR override, emergency rollback) are applied
+    between steps by rebuilding the optax transform — the orchestrator
+    drives them via `adjust_learning_rate`/`rollback`.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, Dict, Iterable, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding
+
+from luminaai_tpu.config import Config
+from luminaai_tpu.models.transformer import LuminaTransformer
+from luminaai_tpu.monitoring.logger import TrainingHealthMonitor
+from luminaai_tpu.parallel.mesh import build_mesh, describe_mesh, initialize_multihost
+from luminaai_tpu.parallel.sharding import batch_spec, init_sharded_state
+from luminaai_tpu.parallel.train_step import make_eval_step, make_train_step
+from luminaai_tpu.training.checkpoint import CheckpointManager
+from luminaai_tpu.training.optimizer import make_optimizer, make_schedule
+from luminaai_tpu.training.precision import PrecisionManager
+
+logger = logging.getLogger(__name__)
+
+
+class Trainer:
+    """End-to-end trainer: mesh + sharded state + loop + eval + checkpoints.
+
+    `train_data` / `eval_data` are callables returning an iterator of batch
+    dicts ({'input_ids': [B, S] int32, optional 'loss_mask'/'loss_weights'})
+    so epochs can restart iteration (ref create_dataloader re-shuffles).
+    """
+
+    def __init__(
+        self,
+        config: Config,
+        train_data: Callable[[], Iterator[Dict[str, np.ndarray]]],
+        eval_data: Optional[Callable[[], Iterator[Dict[str, np.ndarray]]]] = None,
+        model: Optional[LuminaTransformer] = None,
+        checkpoint_dir: Optional[str] = None,
+        total_steps: Optional[int] = None,
+        steps_per_epoch: Optional[int] = None,
+    ):
+        self.config = config
+        self.train_data = train_data
+        self.eval_data = eval_data
+        self.model = model or LuminaTransformer(config)
+        self.precision = PrecisionManager(config)
+
+        if total_steps is None:
+            if config.max_steps:
+                total_steps = config.max_steps
+            elif steps_per_epoch:
+                total_steps = steps_per_epoch * config.num_epochs
+            else:
+                total_steps = 10_000
+        self.total_steps = total_steps
+        self.steps_per_epoch = steps_per_epoch
+
+        initialize_multihost(config)
+        self.mesh = build_mesh(config)
+        logger.info("trainer mesh: %s", describe_mesh(self.mesh))
+        self.schedule = make_schedule(config, total_steps)
+        self.tx = make_optimizer(config, total_steps, self.schedule)
+        self.state, self.shardings = init_sharded_state(
+            config, self.model, self.tx, self.mesh, jax.random.key(config.seed)
+        )
+        self.train_step = make_train_step(
+            config, self.model, self.shardings, self.mesh, self.schedule,
+            self.tx,
+        )
+        self.eval_step = make_eval_step(
+            config, self.model, self.shardings, self.mesh
+        )
+        self._batch_sharding = NamedSharding(self.mesh, batch_spec())
+
+        ckpt_dir = checkpoint_dir or f"{config.output_dir}/checkpoints"
+        self.checkpoints = CheckpointManager(config, ckpt_dir)
+        self.monitor = TrainingHealthMonitor(
+            log_dir=f"{config.output_dir}/logs",
+            loss_spike_threshold=config.loss_spike_threshold,
+            grad_norm_threshold=config.grad_norm_threshold,
+            health_check_interval=config.health_check_interval,
+        )
+
+        self.global_step = 0
+        self.best_eval_loss = float("inf")
+        self._epochs_without_improvement = 0
+        self._consecutive_nonfinite = 0
+        self._first_nonfinite_step: Optional[int] = None
+        self._lr_override: Optional[float] = None
+        self._interventions: list = []
+
+        if config.auto_resume:
+            self.maybe_resume()
+
+    # -- checkpoint/resume ------------------------------------------------
+    def maybe_resume(self) -> bool:
+        step = self.checkpoints.get_resume_step()
+        if step is None:
+            return False
+        self.state = self.checkpoints.restore(self.state, step)
+        self.global_step = int(self.state.step)
+        logger.info("resumed from checkpoint at step %d", self.global_step)
+        return True
+
+    def save_checkpoint(self, metrics=None, force: bool = False) -> None:
+        self.checkpoints.save(self.state, self.global_step, metrics, force=force)
+
+    # -- adaptive hooks (called by the orchestrator) ----------------------
+    def adjust_learning_rate(self, new_lr: float, reason: str = "") -> None:
+        """Override the schedule with a constant LR by rebuilding an optax
+        state-compatible transform (ref trainer.py:1144). Adam moments
+        survive: only the scale-by-schedule factor changes."""
+        logger.warning("LR override -> %.3g (%s)", new_lr, reason)
+        self._lr_override = new_lr
+        cfg = self.config
+        sched = lambda step: jnp.asarray(new_lr, jnp.float32)  # noqa: E731
+        self.tx = make_optimizer(cfg, self.total_steps, sched)
+        self.train_step = make_train_step(
+            cfg, self.model, self.shardings, self.mesh, sched, self.tx
+        )
+        self._interventions.append(
+            {"step": self.global_step, "kind": "lr_override", "lr": new_lr,
+             "reason": reason}
+        )
+
+    def rollback(self, to_step: Optional[int] = None, reason: str = "") -> bool:
+        """Restore an earlier checkpoint after instability
+        (ref trainer.py:1727 rollback_steps)."""
+        steps = self.checkpoints.all_steps()
+        candidates = [s for s in steps if to_step is None or s <= to_step]
+        if not candidates:
+            return False  # never fall forward onto a possibly-tainted save
+        target = max(candidates)
+        self.state = self.checkpoints.restore(self.state, target)
+        self.global_step = int(self.state.step)
+        logger.warning("rolled back to step %d (%s)", target, reason)
+        self._interventions.append(
+            {"step": self.global_step, "kind": "rollback", "reason": reason}
+        )
+        return True
+
+    # -- data -------------------------------------------------------------
+    def _put(self, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
+        return {
+            k: jax.device_put(jnp.asarray(v), self._batch_sharding)
+            for k, v in batch.items()
+        }
+
+    # -- eval -------------------------------------------------------------
+    def evaluate(self, max_batches: int = 100) -> Dict[str, float]:
+        """(ref trainer.py:2667 evaluate)"""
+        if self.eval_data is None:
+            return {}
+        totals: Dict[str, float] = {}
+        count = 0
+        for i, batch in enumerate(self.eval_data()):
+            if i >= max_batches:
+                break
+            metrics = self.eval_step(self.state, self._put(batch))
+            for k, v in metrics.items():
+                if getattr(v, "ndim", 1) == 0:
+                    totals[k] = totals.get(k, 0.0) + float(v)
+            count += 1
+        if count == 0:
+            return {}
+        out = {f"eval_{k}": v / count for k, v in totals.items()}
+        out["eval_loss"] = out.get("eval_loss", out.get("eval_ce_loss", 0.0))
+        return out
+
+    # -- main loop ---------------------------------------------------------
+    def train(self) -> Dict[str, Any]:
+        """Run to total_steps (or num_epochs when steps_per_epoch known).
+
+        Returns a summary dict (ref trainer.py:3180 train)."""
+        cfg = self.config
+        t_start = time.time()
+        tokens_seen = 0
+        last_metrics: Dict[str, Any] = {}
+        log_every = max(1, cfg.health_check_interval // 10)
+        stop = False
+
+        epoch = 0
+        while not stop and self.global_step < self.total_steps:
+            epoch += 1
+            for batch in self.train_data():
+                if self.global_step >= self.total_steps:
+                    break
+                step_t0 = time.time()
+                self.state, metrics = self.train_step(self.state, self._put(batch))
+                self.global_step += 1
+                tokens_seen += int(batch["input_ids"].size)
+
+                if self.global_step % log_every == 0:
+                    scalars = {
+                        k: float(v)
+                        for k, v in metrics.items()
+                        if getattr(v, "ndim", 1) == 0
+                    }
+                    scalars["tokens_per_sec"] = batch["input_ids"].size / max(
+                        time.time() - step_t0, 1e-9
+                    )
+                    self.monitor.log_step(self.global_step, scalars)
+                    last_metrics = scalars
+                    if not np.isfinite(scalars.get("loss", 0.0)):
+                        stop = self._handle_nonfinite()
+                        if stop:
+                            break
+                    else:
+                        self._consecutive_nonfinite = 0
+                        self._first_nonfinite_step = None
+
+                if (
+                    self.eval_data is not None
+                    and self.global_step % cfg.eval_every_n_batches == 0
+                ):
+                    eval_metrics = self.evaluate()
+                    self.monitor.log_step(self.global_step, eval_metrics)
+                    last_metrics.update(eval_metrics)
+                    if self._check_early_stopping(eval_metrics.get("eval_loss")):
+                        stop = True
+                        break
+
+                if (
+                    self.global_step % cfg.save_every_n_batches == 0
+                    and self._first_nonfinite_step is None  # not NaN-suspect
+                ):
+                    self.save_checkpoint(last_metrics)
+
+            if (
+                self.steps_per_epoch is not None
+                and epoch >= cfg.num_epochs
+            ):
+                break
+
+        final_eval = self.evaluate() if self.eval_data is not None else {}
+        last_metrics.update(final_eval)
+        self.save_checkpoint(last_metrics, force=True)
+        self.checkpoints.wait()
+
+        elapsed = time.time() - t_start
+        summary = {
+            "final_step": self.global_step,
+            "epochs": epoch,
+            "elapsed_sec": round(elapsed, 1),
+            "tokens_seen": tokens_seen,
+            "tokens_per_sec": round(tokens_seen / max(elapsed, 1e-9), 1),
+            "final_metrics": {k: v for k, v in last_metrics.items()},
+            "health": self.monitor.get_health_summary(),
+            "interventions": self._interventions,
+        }
+        logger.info("training done: %s", summary)
+        return summary
+
+    # -- failure handling --------------------------------------------------
+    def _handle_nonfinite(self) -> bool:
+        """NaN/Inf loss: rollback strictly before first detection, else abort
+        (ref trainer.py train_with_oom_fallback's instability ladder).
+
+        Detection runs at log granularity; `_first_nonfinite_step` marks the
+        earliest suspect step so rollback never lands on a checkpoint saved
+        inside the NaN window (saves are also suppressed while suspect)."""
+        self._consecutive_nonfinite += 1
+        if self._first_nonfinite_step is None:
+            self._first_nonfinite_step = self.global_step
+        if self._consecutive_nonfinite < 3:
+            logger.warning(
+                "non-finite loss at step %d (%d consecutive)",
+                self.global_step, self._consecutive_nonfinite,
+            )
+            return False
+        safe = self._first_nonfinite_step - 1
+        if self.rollback(to_step=safe, reason="non-finite loss x3"):
+            self._consecutive_nonfinite = 0
+            self._first_nonfinite_step = None
+            return False
+        logger.error(
+            "no checkpoint at or before step %d; aborting with emergency save",
+            safe,
+        )
+        self.checkpoints.emergency_save(
+            self.state, self.global_step, "non-finite loss, no rollback point"
+        )
+        return True
+
+    def _check_early_stopping(self, eval_loss: Optional[float]) -> bool:
+        """(ref trainer.py:3584 _check_early_stopping)"""
+        if eval_loss is None:
+            return False
+        if eval_loss < self.best_eval_loss - 1e-4:
+            self.best_eval_loss = eval_loss
+            self._epochs_without_improvement = 0
+            return False
+        self._epochs_without_improvement += 1
+        patience = self.config.early_stopping_patience
+        if patience is not None and self._epochs_without_improvement >= patience:
+            logger.info(
+                "early stopping: no improvement in %d evals", patience
+            )
+            return True
+        return False
+
+    def close(self) -> None:
+        self.checkpoints.close()

@@ -50,7 +50,7 @@ def run_one_step(cfg):
     state, shardings = init_sharded_state(
         cfg, model, tx, mesh, jax.random.key(0)
     )
-    step = make_train_step(cfg, model, shardings, mesh, schedule)
+    step = make_train_step(cfg, model, shardings, mesh, schedule, tx)
     new_state, metrics = step(state, make_batch(cfg))
     return new_state, metrics, mesh
 
