@@ -1,0 +1,124 @@
+"""Dynamic MoE architecture evolution: expert add/prune param surgery.
+
+Covers the reference's dynamic expert management (ref: Src/Main_Scripts/
+training/trainer.py:1270 add_expert, :1337 _initialize_new_expert, :1378
+prune_expert; decisions from orchestrator.py:389 ArchitectureEvolution).
+The reference mutates nn.ModuleList in place and patches optimizer param
+groups; with functional params the equivalent is pure tree surgery: every
+MoE subtree carries a leading expert axis, so add/prune are concatenations/
+slices along axis 0 (axis -1 for the router), producing a new params pytree
+for a rebuilt model with num_experts ± 1.
+
+New experts initialize as the mean of existing experts plus small noise —
+the ref's strategy — which keeps the router's existing routing roughly
+valid while letting the newcomer differentiate.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+logger = logging.getLogger(__name__)
+
+MOE_MODULE_NAME = "moe"
+ROUTER_NAME = "router"  # [H, E] — expert axis is LAST
+EXPERT_LEADING = ("wi", "wo")  # [E, ...] — expert axis is FIRST
+
+
+def _is_moe_subtree(name: str, subtree: Any) -> bool:
+    return (
+        name == MOE_MODULE_NAME
+        and isinstance(subtree, dict)
+        and ROUTER_NAME in subtree
+    )
+
+
+def _map_moe(params: Dict, fn) -> Dict:
+    """Apply fn to every MoE param dict in the (nested) params tree."""
+
+    def walk(tree):
+        if not isinstance(tree, dict):
+            return tree
+        return {
+            k: fn(v) if _is_moe_subtree(k, v) else walk(v)
+            for k, v in tree.items()
+        }
+
+    return walk(params)
+
+
+def grow_expert(
+    params: Dict, rng: jax.Array, noise_scale: float = 0.01
+) -> Dict:
+    """Return params with one expert appended to every MoE layer."""
+    counter = iter(range(1_000_000))
+
+    def grow(moe: Dict) -> Dict:
+        layer_rng = jax.random.fold_in(rng, next(counter))
+        out = dict(moe)
+        router = moe[ROUTER_NAME]
+        new_col = router.mean(axis=-1, keepdims=True)
+        new_col += noise_scale * jax.random.normal(
+            jax.random.fold_in(layer_rng, 0), new_col.shape, router.dtype
+        )
+        out[ROUTER_NAME] = jnp.concatenate([router, new_col], axis=-1)
+        for i, name in enumerate(EXPERT_LEADING):
+            w = moe[name]
+            new_slab = w.mean(axis=0, keepdims=True)
+            new_slab += noise_scale * jax.random.normal(
+                jax.random.fold_in(layer_rng, i + 1), new_slab.shape, w.dtype
+            )
+            out[name] = jnp.concatenate([w, new_slab], axis=0)
+        return out
+
+    return _map_moe(params, grow)
+
+
+def prune_expert(params: Dict, expert_idx: int) -> Dict:
+    """Return params with expert `expert_idx` removed from every MoE layer."""
+
+    def prune(moe: Dict) -> Dict:
+        out = dict(moe)
+        router = moe[ROUTER_NAME]
+        E = router.shape[-1]
+        if not 0 <= expert_idx < E:
+            raise ValueError(f"expert_idx {expert_idx} out of range [0,{E})")
+        keep = jnp.asarray([i for i in range(E) if i != expert_idx])
+        out[ROUTER_NAME] = jnp.take(router, keep, axis=-1)
+        for name in EXPERT_LEADING:
+            out[name] = jnp.take(moe[name], keep, axis=0)
+        return out
+
+    return _map_moe(params, prune)
+
+
+def num_experts_in(params: Dict) -> Optional[int]:
+    """Read E from the first MoE layer found (None if dense)."""
+    found = []
+
+    def peek(moe):
+        found.append(moe[ROUTER_NAME].shape[-1])
+        return moe
+
+    _map_moe(params, peek)
+    return found[0] if found else None
+
+
+def evolution_feasible(config, new_num_experts: int) -> Tuple[bool, str]:
+    """Check mesh/routing constraints before surgery (the ref's equivalent
+    re-derived ZeRO groups; here the gate is expert-axis divisibility)."""
+    if not config.use_moe:
+        return False, "model has no MoE layers"
+    if new_num_experts < max(2, config.moe_top_k):
+        return False, f"cannot go below {max(2, config.moe_top_k)} experts"
+    if new_num_experts % config.expert_parallel_size != 0:
+        return (
+            False,
+            f"{new_num_experts} experts not divisible by expert_parallel_size="
+            f"{config.expert_parallel_size}",
+        )
+    return True, "ok"

@@ -107,7 +107,14 @@ class Trainer:
         self._consecutive_nonfinite = 0
         self._first_nonfinite_step: Optional[int] = None
         self._lr_override: Optional[float] = None
+        self._active_schedule = self.schedule  # reflects any LR override
+        # Checkpoints older than this are shape-incompatible (expert
+        # evolution changed the param tree) and must never be restored.
+        self._min_restorable_step = 0
         self._interventions: list = []
+        # Orchestrator hook: called with (step, scalar_metrics) at log
+        # cadence; may call adjust_learning_rate/rollback/evolve_experts.
+        self.step_callback: Optional[Callable[[int, Dict[str, float]], None]] = None
 
         if config.auto_resume:
             self.maybe_resume()
@@ -117,7 +124,24 @@ class Trainer:
         step = self.checkpoints.get_resume_step()
         if step is None:
             return False
-        self.state = self.checkpoints.restore(self.state, step)
+        try:
+            self.state = self.checkpoints.restore(self.state, step)
+        except Exception as e:
+            # Most common cause: the run evolved experts after this config
+            # was written, so the stored tree has a different expert count.
+            try:
+                meta = self.checkpoints.load_metadata(step)
+                saved_e = meta.get("config", {}).get("num_experts")
+            except Exception:
+                saved_e = None
+            if saved_e is not None and saved_e != self.config.num_experts:
+                raise ValueError(
+                    f"checkpoint at step {step} was saved with num_experts="
+                    f"{saved_e} (architecture evolved mid-run) but config has "
+                    f"{self.config.num_experts}; set num_experts={saved_e} to "
+                    "resume"
+                ) from e
+            raise
         self.global_step = int(self.state.step)
         logger.info("resumed from checkpoint at step %d", self.global_step)
         return True
@@ -134,6 +158,7 @@ class Trainer:
         self._lr_override = new_lr
         cfg = self.config
         sched = lambda step: jnp.asarray(new_lr, jnp.float32)  # noqa: E731
+        self._active_schedule = sched
         self.tx = make_optimizer(cfg, self.total_steps, sched)
         self.train_step = make_train_step(
             cfg, self.model, self.shardings, self.mesh, sched, self.tx
@@ -143,11 +168,82 @@ class Trainer:
              "reason": reason}
         )
 
+    def evolve_experts(
+        self,
+        action: str,
+        expert_idx: Optional[int] = None,
+        reason: str = "",
+    ) -> bool:
+        """Add or prune an MoE expert mid-run (ref trainer.py:1270,1378).
+
+        Param surgery via training.evolution; optimizer moments reset (the
+        expert axis changed shape, so stale moments would be misaligned);
+        train/eval steps recompile against the new architecture.
+        """
+        from luminaai_tpu.parallel.sharding import state_shardings
+        from luminaai_tpu.training.evolution import (
+            evolution_feasible,
+            grow_expert,
+            prune_expert,
+        )
+
+        cfg = self.config
+        delta = 1 if action == "add_expert" else -1
+        new_E = cfg.num_experts + delta
+        ok, why = evolution_feasible(cfg, new_E)
+        if not ok:
+            logger.warning("expert evolution skipped: %s", why)
+            return False
+
+        if action == "add_expert":
+            new_params = grow_expert(
+                self.state.params, jax.random.key(cfg.seed + self.global_step)
+            )
+        else:
+            if expert_idx is None:
+                raise ValueError("prune requires expert_idx")
+            new_params = prune_expert(self.state.params, expert_idx)
+
+        cfg.num_experts = new_E
+        self.model = LuminaTransformer(cfg)
+        # Keep any active LR override in force across the rebuild.
+        sched = self._active_schedule
+        self.tx = make_optimizer(cfg, self.total_steps, sched)
+        self.shardings = state_shardings(cfg, self.model, self.tx, self.mesh)
+        new_params = jax.device_put(new_params, self.shardings.params)
+        opt_state = jax.jit(
+            self.tx.init, out_shardings=self.shardings.opt_state
+        )(new_params)
+        self.state = self.state.replace(params=new_params, opt_state=opt_state)
+        self.train_step = make_train_step(
+            cfg, self.model, self.shardings, self.mesh, sched, self.tx
+        )
+        self.eval_step = make_eval_step(
+            cfg, self.model, self.shardings, self.mesh
+        )
+        logger.warning(
+            "%s -> %d experts (%s); optimizer moments reset", action, new_E, reason
+        )
+        self._interventions.append(
+            {"step": self.global_step, "kind": action, "num_experts": new_E,
+             "reason": reason}
+        )
+        # Older checkpoints are now shape-incompatible: fence them off and
+        # immediately bank a restorable post-surgery checkpoint.
+        self._min_restorable_step = self.global_step
+        self.save_checkpoint(force=True)
+        return True
+
     def rollback(self, to_step: Optional[int] = None, reason: str = "") -> bool:
         """Restore an earlier checkpoint after instability
         (ref trainer.py:1727 rollback_steps)."""
         steps = self.checkpoints.all_steps()
-        candidates = [s for s in steps if to_step is None or s <= to_step]
+        candidates = [
+            s for s in steps
+            if (to_step is None or s <= to_step)
+            and s >= self._min_restorable_step  # pre-evolution saves are
+            # shape-incompatible with the current param tree
+        ]
         if not candidates:
             return False  # never fall forward onto a possibly-tainted save
         target = max(candidates)
@@ -221,6 +317,13 @@ class Trainer:
                     )
                     self.monitor.log_step(self.global_step, scalars)
                     last_metrics = scalars
+                    if self.step_callback is not None:
+                        cb_metrics = dict(scalars)
+                        if "expert_utilization" in metrics:
+                            cb_metrics["expert_utilization"] = np.asarray(
+                                metrics["expert_utilization"]
+                            )
+                        self.step_callback(self.global_step, cb_metrics)
                     if not np.isfinite(scalars.get("loss", 0.0)):
                         stop = self._handle_nonfinite()
                         if stop:

@@ -1,0 +1,251 @@
+"""Orchestrator, scaler and expert-evolution tests (SURVEY.md §4:
+'orchestrator intervention fires on synthetic anomaly')."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from luminaai_tpu.config import Config
+from luminaai_tpu.training.evolution import (
+    evolution_feasible,
+    grow_expert,
+    num_experts_in,
+    prune_expert,
+)
+from luminaai_tpu.training.orchestrator import (
+    AdaptiveHyperparameterOptimizer,
+    AdaptiveTrainingOrchestrator,
+    ArchitectureEvolution,
+    MetaLearningEngine,
+    ProductionMonitoring,
+    RealTimeAnalytics,
+)
+from luminaai_tpu.training.scaler import (
+    ChinchillaScaler,
+    ComputeEfficiencyTracker,
+    ConvergenceDetector,
+)
+from luminaai_tpu.training.trainer import Trainer
+
+
+def tiny_config(tmp, **kw) -> Config:
+    base = dict(
+        vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+        num_kv_heads=2, seq_length=64, batch_size=8,
+        use_flash_attention=False, gradient_checkpointing=False,
+        precision="fp32", max_steps=30, eval_every_n_batches=1000,
+        save_every_n_batches=10, health_check_interval=5,
+        intervention_cooldown_steps=10, output_dir=str(tmp),
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+def patterned_data(cfg, n_batches=200):
+    def gen():
+        rng = np.random.RandomState(0)
+        for _ in range(n_batches):
+            starts = rng.randint(0, 32, size=(cfg.batch_size, 1))
+            seq = (starts + np.arange(cfg.seq_length)) % 64 + 1
+            yield {"input_ids": seq.astype(np.int32)}
+
+    return gen
+
+
+# -- analytics ------------------------------------------------------------
+def test_analytics_detects_loss_spike_and_grad_explosion():
+    a = RealTimeAnalytics()
+    for i in range(60):
+        a.observe(i, 1.0 + 0.001 * np.random.RandomState(i).randn(), 1.0)
+    for i in range(60, 70):
+        a.observe(i, 3.5, 500.0)
+    types = {x["type"] for x in a.detect_anomalies()}
+    assert "loss_spike" in types and "gradient_explosion" in types
+
+
+def test_analytics_expert_collapse():
+    a = RealTimeAnalytics()
+    util = np.array([7.5, 0.001, 0.2, 0.3])
+    for i in range(60):
+        a.observe(i, 1.0, 1.0, util)
+    assert any(x["type"] == "expert_collapse" for x in a.detect_anomalies())
+
+
+def test_loss_dynamics_trend():
+    a = RealTimeAnalytics()
+    for i in range(100):
+        a.observe(i, 5.0 - 0.03 * i, 1.0)
+    insights = a.analyze_loss_dynamics()
+    assert insights["trend_direction"] == "decreasing"
+
+
+# -- hyperparameter optimizer ---------------------------------------------
+def test_hyper_optimizer_divergence_cuts_lr():
+    h = AdaptiveHyperparameterOptimizer(min_gap_steps=0)
+    for i in range(20):
+        h.observe(i, 1.0, 1.0)
+    for i in range(20, 26):
+        h.observe(i, 2.5, 1.0)
+    prop = h.propose(26)
+    assert prop is not None and prop["action"] == "decrease"
+
+
+def test_hyper_optimizer_plateau_raises_lr():
+    h = AdaptiveHyperparameterOptimizer(min_gap_steps=0)
+    for i in range(25):
+        h.observe(i, 1.8, 1.0)
+    prop = h.propose(25)
+    assert prop is not None and prop["action"] == "increase"
+
+
+# -- architecture evolution ------------------------------------------------
+def test_evolution_prune_on_dead_expert():
+    e = ArchitectureEvolution(window=5)
+    util = np.array([2.0, 0.01, 1.0, 1.0])
+    for _ in range(5):
+        e.observe(util, drop_rate=0.0)
+    prop = e.propose()
+    assert prop["action"] == "prune_expert" and prop["expert_idx"] == 1
+
+
+def test_evolution_add_on_capacity_pressure():
+    e = ArchitectureEvolution(window=5)
+    util = np.ones(4)
+    for _ in range(5):
+        e.observe(util, drop_rate=0.3)
+    assert e.propose()["action"] == "add_expert"
+
+
+# -- expert param surgery --------------------------------------------------
+def moe_params(E=4, H=8, F=16):
+    key = jax.random.key(0)
+    return {
+        "layer_0": {
+            "moe": {
+                "router": jax.random.normal(key, (H, E)),
+                "wi": jax.random.normal(key, (E, H, 2 * F)),
+                "wo": jax.random.normal(key, (E, F, H)),
+            },
+            "ffn": {"kernel": jax.random.normal(key, (H, H))},
+        }
+    }
+
+
+def test_grow_and_prune_expert_shapes():
+    p = moe_params(E=4)
+    grown = grow_expert(p, jax.random.key(1))
+    assert num_experts_in(grown) == 5
+    assert grown["layer_0"]["moe"]["wi"].shape[0] == 5
+    # Non-MoE params untouched.
+    assert grown["layer_0"]["ffn"]["kernel"].shape == (8, 8)
+    pruned = prune_expert(grown, 2)
+    assert num_experts_in(pruned) == 4
+    # New expert starts near the mean of the others.
+    mean_wi = p["layer_0"]["moe"]["wi"].mean(axis=0)
+    np.testing.assert_allclose(
+        grown["layer_0"]["moe"]["wi"][4], mean_wi, atol=0.1
+    )
+
+
+def test_evolution_feasibility_gates():
+    cfg = Config(use_moe=True, num_experts=8, expert_parallel_size=4,
+                 hidden_size=64, num_heads=4, num_kv_heads=2, vocab_size=128)
+    ok, why = evolution_feasible(cfg, 9)
+    assert not ok and "divisible" in why
+    ok, _ = evolution_feasible(cfg, 12)
+    assert ok
+
+
+def test_trainer_evolve_experts_end_to_end(tmp_path):
+    cfg = tiny_config(tmp_path, use_moe=True, num_experts=4, max_steps=2)
+    t = Trainer(cfg, train_data=patterned_data(cfg),
+                checkpoint_dir=str(tmp_path / "ckpt"))
+    batch = t._put(next(patterned_data(cfg)()))
+    t.state, m1 = t.train_step(t.state, batch)
+    assert t.evolve_experts("add_expert", reason="test")
+    assert cfg.num_experts == 5
+    t.state, m2 = t.train_step(t.state, batch)  # recompiled step runs
+    assert np.isfinite(float(m2["loss"]))
+    assert t.evolve_experts("prune_expert", expert_idx=4, reason="test")
+    assert cfg.num_experts == 4
+    t.close()
+
+
+# -- orchestrated training -------------------------------------------------
+def test_orchestrator_intervenes_on_synthetic_anomaly(tmp_path):
+    """Feed the orchestrator a fabricated divergence; LR override fires."""
+    # max_steps=200 keeps the fabricated steps inside the schedule body
+    # (LR interventions are gated off during warmup and terminal decay).
+    cfg = tiny_config(tmp_path, enable_adaptive_lr=True,
+                      min_override_threshold=0.2, max_steps=200)
+    t = Trainer(cfg, train_data=patterned_data(cfg),
+                checkpoint_dir=str(tmp_path / "ckpt"))
+    orch = AdaptiveTrainingOrchestrator(t)
+    for i in range(5, 105, 5):
+        loss = 1.0 if i < 75 else 4.0  # divergence at the end
+        orch.on_metrics(i, {"loss": loss, "grad_norm": 1.0})
+    applied = [d for d in orch.decisions if d.applied]
+    assert applied, "no intervention fired on synthetic divergence"
+    assert t._lr_override is not None and t._lr_override < cfg.learning_rate
+
+
+def test_orchestrated_run_end_to_end(tmp_path):
+    cfg = tiny_config(tmp_path, max_steps=12, health_check_interval=4)
+    t = Trainer(cfg, train_data=patterned_data(cfg),
+                eval_data=patterned_data(cfg, n_batches=2),
+                checkpoint_dir=str(tmp_path / "ckpt"))
+    orch = AdaptiveTrainingOrchestrator(t)
+    summary = orch.run()
+    assert summary["final_step"] == 12
+    assert "adaptive_decisions" in summary
+    # Meta-learning recorded the run.
+    meta2 = MetaLearningEngine(f"{cfg.output_dir}/meta_history.jsonl")
+    assert len(meta2.runs) == 1
+    sugg = meta2.suggest_hyperparameters(cfg)
+    assert sugg == {} or "learning_rate" in sugg
+    t.close()
+
+
+# -- scaler ----------------------------------------------------------------
+def test_chinchilla_plan():
+    cfg = Config(hidden_size=64, num_layers=2, num_heads=4, num_kv_heads=2,
+                 vocab_size=128, batch_size=8, seq_length=64,
+                 use_chinchilla_scaling=True)
+    plan = ChinchillaScaler(cfg).plan(dataset_tokens=1_000_000)
+    assert plan.optimal_tokens == int(20.0 * cfg.estimate_parameters())
+    assert plan.recommended_steps == plan.optimal_tokens // (8 * 64)
+    sc = ChinchillaScaler(cfg)
+    steps = sc.apply()
+    assert cfg.max_steps == steps
+
+
+def test_convergence_detector():
+    d = ConvergenceDetector(patience=3, min_steps=0)
+    assert not d.update(2.0, 10)
+    assert not d.update(1.5, 20)
+    assert not d.update(1.501, 30)
+    assert not d.update(1.502, 40)
+    assert d.update(1.503, 50)  # 3rd stale
+
+
+def test_efficiency_tracker_mfu():
+    tr = ComputeEfficiencyTracker(active_params=1_000_000, n_chips=1,
+                                  peak_flops=100e12)
+    s = tr.record(tokens=10_000, seconds=1.0)
+    # 6*1e6*1e4 = 6e10 FLOPs in 1s → 0.06% of 100 TFLOPs.
+    assert abs(s["mfu"] - 6e-4) < 1e-6
+
+
+# -- production monitoring --------------------------------------------------
+def test_production_monitoring_drift_and_safety():
+    p = ProductionMonitoring()
+    ref = ["the cat sat on the mat"] * 10
+    same = p.monitor_semantic_drift(["the cat sat on the mat"], ref)
+    assert same is None
+    drifted = p.monitor_semantic_drift(
+        ["zx qv wk jj pq mm nn oo"] * 5, ref
+    )
+    assert drifted is not None and drifted["alert"] == "semantic_drift"
+    flags = p.track_safety_metrics(["please give me your credit card number"])
+    assert flags and flags[0]["metric"] == "flagged_content"
