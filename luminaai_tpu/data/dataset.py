@@ -1,0 +1,354 @@
+"""Datasets: jsonl conversations, memmap token cache, packed batches,
+prefetching loader.
+
+Covers the reference dataset stack (ref: Src/Main_Scripts/core/dataset.py —
+FastConversationDataset w/ validation+loss weights, FastBaseTrainingDataset
+w/ chunking, streaming variants above a size threshold, FastDataLoader w/
+prefetch :807, hybrid/interleaved managers). TPU-shape differences:
+
+  - The token store is a flat int32 memmap + offset table (built once,
+    mmap'd thereafter); batch assembly is the native C++ packer
+    (native/dataloader.cpp) with a bit-identical numpy fallback — replacing
+    torch DataLoader workers with one packer call per batch.
+  - Batches are globally-shaped [global_batch, seq]: sharding over the mesh
+    happens at device_put against the batch sharding, not per-worker.
+  - Prefetch is a background thread keeping `prefetch_batches` ready;
+    device transfer overlaps the current step (double buffering).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+from pathlib import Path
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from luminaai_tpu.config import Config
+from luminaai_tpu.data.tokenizer import ConversationTokenizer
+from luminaai_tpu.native import pack_batch, shuffle_indices
+
+logger = logging.getLogger(__name__)
+
+CACHE_VERSION = 1
+
+
+# ---------------------------------------------------------------------------
+# Token cache (memmap)
+# ---------------------------------------------------------------------------
+class TokenCache:
+    """Flat token stream + document offsets on disk.
+
+    Files: <stem>.tokens.bin (int32), <stem>.offsets.npy (int64 n+1),
+    <stem>.meta.json. Build once from any doc iterator; reopen is mmap-fast
+    (ref dataset caching + memmap fast path).
+    """
+
+    def __init__(self, stem: str):
+        self.stem = Path(stem)
+        self.tokens_path = self.stem.with_suffix(".tokens.bin")
+        self.offsets_path = self.stem.with_suffix(".offsets.npy")
+        self.meta_path = self.stem.with_suffix(".meta.json")
+        self.tokens: Optional[np.ndarray] = None
+        self.offsets: Optional[np.ndarray] = None
+        self.meta: Dict[str, Any] = {}
+
+    def exists(self) -> bool:
+        return (
+            self.tokens_path.exists()
+            and self.offsets_path.exists()
+            and self.meta_path.exists()
+        )
+
+    def build(
+        self, docs: Iterator[Sequence[int]], meta: Optional[Dict] = None
+    ) -> "TokenCache":
+        self.stem.parent.mkdir(parents=True, exist_ok=True)
+        offsets = [0]
+        n = 0
+        with self.tokens_path.open("wb") as f:
+            for doc in docs:
+                arr = np.asarray(doc, dtype=np.int32)
+                arr.tofile(f)
+                n += arr.size
+                offsets.append(n)
+        np.save(self.offsets_path, np.asarray(offsets, dtype=np.int64))
+        self.meta = {
+            "version": CACHE_VERSION,
+            "n_docs": len(offsets) - 1,
+            "n_tokens": n,
+            **(meta or {}),
+        }
+        self.meta_path.write_text(json.dumps(self.meta))
+        return self.open()
+
+    def open(self) -> "TokenCache":
+        self.meta = json.loads(self.meta_path.read_text())
+        self.tokens = np.memmap(self.tokens_path, dtype=np.int32, mode="r")
+        self.offsets = np.load(self.offsets_path)
+        return self
+
+    @property
+    def n_docs(self) -> int:
+        return len(self.offsets) - 1
+
+    @property
+    def n_tokens(self) -> int:
+        return int(self.offsets[-1])
+
+
+# ---------------------------------------------------------------------------
+# Conversation dataset (chat finetuning)
+# ---------------------------------------------------------------------------
+def read_jsonl(path: str, max_records: Optional[int] = None) -> Iterator[Dict]:
+    with open(path) as f:
+        for i, line in enumerate(f):
+            if max_records is not None and i >= max_records:
+                break
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except json.JSONDecodeError:
+                logger.warning("%s:%d bad json skipped", path, i + 1)
+
+
+class ConversationDataset:
+    """jsonl conversations → fixed-length tokenized samples w/ loss weights
+    (ref FastConversationDataset, core/dataset.py:337).
+
+    Eager for small files; `streaming_threshold_gb` switches to on-the-fly
+    iteration (ref FastStreamingBaseTrainingDataset, :241).
+    """
+
+    def __init__(
+        self,
+        data_path: str,
+        tokenizer: ConversationTokenizer,
+        config: Config,
+        split: str = "train",
+    ):
+        self.path = data_path
+        self.tokenizer = tokenizer
+        self.config = config
+        self.split = split
+        size_gb = Path(data_path).stat().st_size / 1e9
+        self.streaming = size_gb > config.streaming_threshold_gb
+        self.samples: List[Dict[str, np.ndarray]] = []
+        self.skipped = 0
+        if not self.streaming:
+            self._load_eager()
+
+    def _load_eager(self) -> None:
+        for conv in read_jsonl(self.path):
+            enc = self.tokenizer.encode_conversation(
+                conv,
+                max_length=self.config.seq_length,
+                pad_to_length=self.config.seq_length,
+            )
+            if enc is None:
+                self.skipped += 1
+                continue
+            self.samples.append(enc)
+        logger.info(
+            "%s: %d conversations (%d skipped)",
+            self.path, len(self.samples), self.skipped,
+        )
+
+    def __len__(self) -> int:
+        if self.streaming:
+            raise TypeError("streaming dataset has no length")
+        return len(self.samples)
+
+    def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
+        return self.samples[idx]
+
+    def iter_samples(self) -> Iterator[Dict[str, np.ndarray]]:
+        if not self.streaming:
+            yield from self.samples
+            return
+        for conv in read_jsonl(self.path):
+            enc = self.tokenizer.encode_conversation(
+                conv,
+                max_length=self.config.seq_length,
+                pad_to_length=self.config.seq_length,
+            )
+            if enc is not None:
+                yield enc
+
+    def stats(self) -> Dict[str, Any]:
+        if self.streaming:
+            return {"streaming": True, "path": self.path}
+        lens = [int(s["loss_mask"].sum()) for s in self.samples]
+        return {
+            "streaming": False,
+            "n_samples": len(self.samples),
+            "skipped": self.skipped,
+            "mean_assistant_tokens": float(np.mean(lens)) if lens else 0.0,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Packed dataset (base training over a TokenCache)
+# ---------------------------------------------------------------------------
+class PackedDataset:
+    """Contiguous packed [B, S] batches from a TokenCache via the native
+    packer (ref FastBaseTrainingDataset chunking, :118)."""
+
+    def __init__(
+        self,
+        cache: TokenCache,
+        batch_size: int,
+        seq_length: int,
+        pad_id: int = 0,
+        eos_id: int = -1,
+        shuffle_seed: Optional[int] = None,
+    ):
+        if cache.tokens is None:
+            cache.open()
+        self.cache = cache
+        self.batch_size = batch_size
+        self.seq_length = seq_length
+        self.pad_id = pad_id
+        self.eos_id = eos_id
+        self.shuffle_seed = shuffle_seed
+
+    def batches_per_epoch(self) -> int:
+        per_batch = self.batch_size * self.seq_length
+        return max(1, self.cache.n_tokens // per_batch)
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        offsets = self.cache.offsets
+        tokens = self.cache.tokens
+        if self.shuffle_seed is not None:
+            # Shuffle documents by reordering the offset walk: build a
+            # permuted (tokens, offsets) view once per epoch.
+            perm = shuffle_indices(self.cache.n_docs, self.shuffle_seed)
+            lengths = (offsets[1:] - offsets[:-1])[perm]
+            new_offsets = np.concatenate(
+                [[0], np.cumsum(lengths)]
+            ).astype(np.int64)
+            gather = np.concatenate(
+                [
+                    np.arange(offsets[d], offsets[d + 1])
+                    for d in perm
+                ]
+            ) if self.cache.n_docs else np.empty(0, np.int64)
+            tokens = np.asarray(tokens)[gather]
+            offsets = new_offsets
+        doc, tok = 0, 0
+        n_docs = len(offsets) - 1
+        while doc < n_docs:
+            out, mask, doc, tok = pack_batch(
+                tokens, offsets, doc,
+                self.batch_size, self.seq_length,
+                pad_id=self.pad_id, eos_id=self.eos_id,
+                split_docs=True, start_token=tok,
+            )
+            if mask.sum() == 0:
+                break
+            yield {
+                "input_ids": out,
+                "loss_mask": mask.astype(np.float32),
+            }
+
+
+# ---------------------------------------------------------------------------
+# Prefetching loader
+# ---------------------------------------------------------------------------
+class PrefetchLoader:
+    """Background-thread prefetch of host batches (ref FastDataLoader
+    prefetch, core/dataset.py:807). Device placement stays with the caller
+    (Trainer._put) so sharding logic lives in one place."""
+
+    _DONE = object()
+
+    def __init__(
+        self,
+        batch_fn: Callable[[], Iterator[Dict[str, np.ndarray]]],
+        prefetch: int = 2,
+    ):
+        self.batch_fn = batch_fn
+        self.prefetch = max(1, prefetch)
+
+    def __call__(self) -> Iterator[Dict[str, np.ndarray]]:
+        return self.__iter__()
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        error: List[BaseException] = []
+
+        def worker():
+            try:
+                for b in self.batch_fn():
+                    q.put(b)
+            except BaseException as e:  # pragma: no cover - propagated below
+                error.append(e)
+            finally:
+                q.put(self._DONE)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is self._DONE:
+                break
+            yield item
+        if error:
+            raise error[0]
+
+
+# ---------------------------------------------------------------------------
+# Assembly helpers
+# ---------------------------------------------------------------------------
+def conversation_batches(
+    dataset: ConversationDataset,
+    batch_size: int,
+    seed: int = 0,
+    drop_last: bool = True,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Group per-conversation samples into [B, S] batches."""
+    if dataset.streaming:
+        buf: List[Dict[str, np.ndarray]] = []
+        for s in dataset.iter_samples():
+            buf.append(s)
+            if len(buf) == batch_size:
+                yield _stack(buf)
+                buf = []
+        if buf and not drop_last:
+            yield _stack(buf)
+        return
+    idx = shuffle_indices(len(dataset), seed)
+    for i in range(0, len(idx) - batch_size + 1, batch_size):
+        yield _stack([dataset[int(j)] for j in idx[i:i + batch_size]])
+
+
+def _stack(samples: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
+    return {
+        k: np.stack([s[k] for s in samples]) for k in samples[0].keys()
+    }
+
+
+def build_text_cache(
+    jsonl_path: str,
+    cache_stem: str,
+    tokenizer: ConversationTokenizer,
+    text_key: str = "text",
+    rebuild: bool = False,
+) -> TokenCache:
+    """Tokenize a jsonl of {text_key: str} docs into a TokenCache."""
+    cache = TokenCache(cache_stem)
+    if cache.exists() and not rebuild:
+        return cache.open()
+
+    def docs():
+        for rec in read_jsonl(jsonl_path):
+            text = rec.get(text_key)
+            if text:
+                yield tokenizer.encode_text(text) + [tokenizer.eos_token_id]
+
+    return cache.build(docs(), meta={"source": jsonl_path})

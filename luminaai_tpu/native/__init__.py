@@ -1,0 +1,188 @@
+"""Native (C++) runtime helpers, loaded via ctypes with build-on-demand.
+
+The .so is compiled once per machine into a cache dir (g++ -O3); every
+entry point has a pure-numpy fallback so the package works without a
+toolchain. See dataloader.cpp for the packer contract.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import tempfile
+from pathlib import Path
+from typing import Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_SRC = Path(__file__).parent / "dataloader.cpp"
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _cache_dir() -> Path:
+    d = os.environ.get("LUMINA_NATIVE_CACHE") or os.path.join(
+        tempfile.gettempdir(), "luminaai_tpu_native"
+    )
+    p = Path(d)
+    p.mkdir(parents=True, exist_ok=True)
+    return p
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    src = _SRC.read_bytes()
+    tag = hashlib.sha256(src).hexdigest()[:16]
+    so = _cache_dir() / f"dataloader_{tag}.so"
+    if not so.exists():
+        cmd = [
+            "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+            str(_SRC), "-o", str(so),
+        ]
+        try:
+            subprocess.run(
+                cmd, check=True, capture_output=True, timeout=120
+            )
+        except Exception as e:  # pragma: no cover - toolchain-dependent
+            logger.warning("native build failed (%s); using numpy fallback", e)
+            return None
+    try:
+        lib = ctypes.CDLL(str(so))
+    except OSError as e:  # pragma: no cover
+        logger.warning("native load failed (%s); using numpy fallback", e)
+        return None
+    lib.lumina_pack_batch.restype = ctypes.c_long
+    lib.lumina_pack_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_int32),  # tokens
+        ctypes.POINTER(ctypes.c_int64),  # offsets
+        ctypes.c_long, ctypes.c_long, ctypes.c_long,  # n_docs, start_doc, start_token
+        ctypes.POINTER(ctypes.c_int32),  # out
+        ctypes.POINTER(ctypes.c_int32),  # out_mask
+        ctypes.c_long, ctypes.c_long,    # batch, seq_len
+        ctypes.c_int32, ctypes.c_int32,  # pad_id, eos_id
+        ctypes.c_int,                    # split_docs
+        ctypes.POINTER(ctypes.c_long),   # out_token_cursor
+    ]
+    lib.lumina_shuffle_indices.restype = None
+    lib.lumina_shuffle_indices.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_long, ctypes.c_uint64
+    ]
+    return lib
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if not _TRIED:
+        _TRIED = True
+        _LIB = _build()
+    return _LIB
+
+
+def native_available() -> bool:
+    return get_lib() is not None
+
+
+def _as_c(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def pack_batch(
+    tokens: np.ndarray,
+    doc_offsets: np.ndarray,
+    start_doc: int,
+    batch: int,
+    seq_len: int,
+    pad_id: int,
+    eos_id: int = -1,
+    split_docs: bool = True,
+    start_token: int = 0,
+    use_native: bool = True,
+) -> Tuple[np.ndarray, np.ndarray, int, int]:
+    """Pack documents into a [batch, seq_len] int32 grid + mask.
+
+    Returns (batch_tokens, mask, next_doc, next_token_offset) — the cursor
+    pair resumes packing exactly where this call stopped.
+    """
+    tokens = np.ascontiguousarray(tokens, dtype=np.int32)
+    doc_offsets = np.ascontiguousarray(doc_offsets, dtype=np.int64)
+    n_docs = len(doc_offsets) - 1
+    out = np.empty((batch, seq_len), dtype=np.int32)
+    mask = np.empty((batch, seq_len), dtype=np.int32)
+
+    lib = get_lib() if use_native else None
+    if lib is not None:
+        cursor = ctypes.c_long(0)
+        next_doc = lib.lumina_pack_batch(
+            _as_c(tokens, ctypes.c_int32),
+            _as_c(doc_offsets, ctypes.c_int64),
+            n_docs, start_doc, start_token,
+            _as_c(out, ctypes.c_int32),
+            _as_c(mask, ctypes.c_int32),
+            batch, seq_len, pad_id, eos_id,
+            1 if split_docs else 0,
+            ctypes.byref(cursor),
+        )
+        if next_doc >= 0:
+            return out, mask, int(next_doc), int(cursor.value)
+        logger.warning("native packer error; falling back to numpy")
+
+    return _pack_batch_numpy(
+        tokens, doc_offsets, start_doc, start_token, out, mask,
+        batch, seq_len, pad_id, eos_id, split_docs,
+    )
+
+
+def _pack_batch_numpy(
+    tokens, doc_offsets, start_doc, start_token, out, mask,
+    batch, seq_len, pad_id, eos_id, split_docs,
+):
+    """Reference implementation; semantics identical to the C++ packer."""
+    out.fill(pad_id)
+    mask.fill(0)
+    n_docs = len(doc_offsets) - 1
+    doc, tok_in_doc = start_doc, start_token
+    for row in range(batch):
+        col = 0
+        while col < seq_len and doc < n_docs:
+            beg = int(doc_offsets[doc]) + tok_in_doc
+            end = int(doc_offsets[doc + 1])
+            avail = end - beg
+            if avail <= 0:
+                doc += 1
+                tok_in_doc = 0
+                continue
+            take = min(avail, seq_len - col)
+            out[row, col:col + take] = tokens[beg:beg + take]
+            mask[row, col:col + take] = 1
+            col += take
+            if take == avail:
+                doc += 1
+                tok_in_doc = 0
+                if eos_id >= 0 and col < seq_len:
+                    out[row, col] = eos_id
+                    mask[row, col] = 1
+                    col += 1
+            else:
+                tok_in_doc += take
+                if not split_docs:
+                    doc += 1
+                    tok_in_doc = 0
+                break
+        if doc >= n_docs:
+            break
+    return out, mask, doc, tok_in_doc
+
+
+def shuffle_indices(n: int, seed: int, use_native: bool = True) -> np.ndarray:
+    idx = np.arange(n, dtype=np.int64)
+    lib = get_lib() if use_native else None
+    if lib is not None:
+        lib.lumina_shuffle_indices(_as_c(idx, ctypes.c_int64), n, seed)
+        return idx
+    rng = np.random.RandomState(seed & 0x7FFFFFFF)
+    rng.shuffle(idx)
+    return idx

@@ -280,6 +280,13 @@ class MetaLearningEngine:
     def record_training_outcome(
         self, config: Config, final_metrics: Dict[str, float]
     ) -> None:
+        try:
+            import jax
+
+            if jax.process_index() != 0:
+                return  # one history line per run, not per host
+        except Exception:  # pragma: no cover
+            pass
         entry = {
             "ts": time.time(),
             "params": config.estimate_parameters(),
@@ -399,6 +406,12 @@ class AdaptiveTrainingOrchestrator:
         self.decisions: List[AdaptiveDecision] = []
         self._last_intervention_step = -10**9
         self._last_health_check_step = 0
+        # Rollback fence: last step where loss looked healthy (near its
+        # running best). Periodic saves continue during a *finite* loss
+        # spike, so "latest checkpoint" may hold diverged weights — restore
+        # at/before this step instead.
+        self._best_loss = float("inf")
+        self._last_healthy_step = 0
         self._base_lr = self.config.learning_rate
         self.analytics.thresholds["gradient_explosion_threshold"] = (
             self.config.grad_norm_threshold
@@ -428,6 +441,11 @@ class AdaptiveTrainingOrchestrator:
         self.hyper.observe(step, loss, grad_norm)
         if util is not None:
             self.evolution.observe(util, metrics.get("moe_drop_rate", 0.0))
+        if math.isfinite(loss):
+            if loss < self._best_loss:
+                self._best_loss = loss
+            if loss <= self._best_loss + max(0.25, 0.1 * abs(self._best_loss)):
+                self._last_healthy_step = step
 
         # Elapsed-based cadence: callbacks arrive at the trainer's log
         # granularity, which need not divide health_check_interval.
@@ -519,10 +537,18 @@ class AdaptiveTrainingOrchestrator:
                 )
                 applied = True
             elif kind == "rollback":
-                if t.rollback(reason=decision.reason):
+                # Fence to the last healthy step: periodic saves keep
+                # landing during a finite divergence, so the newest
+                # checkpoint may hold spiked weights.
+                if t.rollback(
+                    to_step=self._last_healthy_step, reason=decision.reason
+                ):
                     applied = True
+                    self._reset_windows_after_rollback()
                 else:
-                    logger.warning("rollback unavailable; cutting LR instead")
+                    # No healthy checkpoint: a newer (spiked) one would only
+                    # re-diverge — cut LR instead.
+                    logger.warning("no healthy checkpoint; cutting LR instead")
                     t.adjust_learning_rate(
                         max(self._current_lr() * 0.1, self.config.min_lr),
                         reason=f"EMERGENCY (no checkpoint): {decision.reason}",
@@ -537,28 +563,33 @@ class AdaptiveTrainingOrchestrator:
                 if applied:
                     self.evolution.reset()  # old-shape windows are stale
             elif kind == "clip_tighten":
-                old = self.config.grad_clip_norm
-                self.config.grad_clip_norm = max(0.1, old * 0.5)
-                from luminaai_tpu.parallel.train_step import make_train_step
-
-                t.train_step = make_train_step(
-                    self.config, t.model, t.shardings, t.mesh,
-                    t._active_schedule, t.tx,
-                )
-                logger.warning(
-                    "grad clip %.2f -> %.2f (%s)",
-                    old, self.config.grad_clip_norm, decision.reason,
+                t.set_grad_clip(
+                    max(0.1, t.config.grad_clip_norm * 0.5),
+                    reason=decision.reason,
                 )
                 applied = True
             decision.applied = applied
             if applied:
                 # An infeasible no-op must not burn the cooldown window.
-                self._last_intervention_step = decision.step
+                # After a rollback, steps replay from the restored point, so
+                # anchor the cooldown there (decision.step would push it
+                # into the future and over-extend suppression).
+                self._last_intervention_step = min(
+                    decision.step, t.global_step
+                )
         except Exception as e:  # pragma: no cover - defensive
             logger.error("intervention %s failed: %s", kind, e)
         self.decisions.append(decision)
         if self.config.log_lr_decisions:
             logger.info("decision: %s", decision.to_dict())
+
+    def _reset_windows_after_rollback(self) -> None:
+        """Observations from the abandoned timeline would poison baselines
+        (spike data in history windows, non-monotonic steps)."""
+        self.analytics.buffer.clear()
+        self.hyper.buffer.clear()
+        self.evolution.reset()
+        self._last_health_check_step = self.trainer.global_step
 
     def _current_lr(self) -> float:
         if self.trainer._lr_override is not None:

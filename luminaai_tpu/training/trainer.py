@@ -214,6 +214,22 @@ class Trainer:
         opt_state = jax.jit(
             self.tx.init, out_shardings=self.shardings.opt_state
         )(new_params)
+        # tx.init resets optax's internal counts to 0; restore them to the
+        # true step so the LR schedule does NOT silently replay warmup.
+        step_now = int(self.state.step)
+
+        def _restore_counts(path, leaf):
+            last = path[-1]
+            if (
+                isinstance(last, jax.tree_util.GetAttrKey)
+                and last.name == "count"
+            ):
+                # Fresh buffer per leaf: sharing one array across leaves
+                # breaks the donated train step (same buffer donated twice).
+                return jnp.array(step_now, leaf.dtype)
+            return leaf
+
+        opt_state = jax.tree_util.tree_map_with_path(_restore_counts, opt_state)
         self.state = self.state.replace(params=new_params, opt_state=opt_state)
         self.train_step = make_train_step(
             cfg, self.model, self.shardings, self.mesh, sched, self.tx
@@ -233,6 +249,21 @@ class Trainer:
         self._min_restorable_step = self.global_step
         self.save_checkpoint(force=True)
         return True
+
+    def set_grad_clip(self, norm: float, reason: str = "") -> None:
+        """Change the gradient-clip norm mid-run (rebuilds the jitted step;
+        clipping is traced into it). Companion to adjust_learning_rate."""
+        old = self.config.grad_clip_norm
+        self.config.grad_clip_norm = norm
+        self.train_step = make_train_step(
+            self.config, self.model, self.shardings, self.mesh,
+            self._active_schedule, self.tx,
+        )
+        logger.warning("grad clip %.3g -> %.3g (%s)", old, norm, reason)
+        self._interventions.append(
+            {"step": self.global_step, "kind": "grad_clip", "from": old,
+             "to": norm, "reason": reason}
+        )
 
     def rollback(self, to_step: Optional[int] = None, reason: str = "") -> bool:
         """Restore an earlier checkpoint after instability

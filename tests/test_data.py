@@ -1,0 +1,223 @@
+"""Tokenizer, dataset, and native-packer tests (SURVEY.md §4: tokenizer
+round-trip + mask correctness; packing; cache)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from luminaai_tpu.config import Config
+from luminaai_tpu.data.dataset import (
+    ConversationDataset,
+    PackedDataset,
+    PrefetchLoader,
+    TokenCache,
+    build_text_cache,
+    conversation_batches,
+)
+from luminaai_tpu.data.tokenizer import ConversationTokenizer
+from luminaai_tpu.native import (
+    _pack_batch_numpy,
+    native_available,
+    pack_batch,
+    shuffle_indices,
+)
+
+
+@pytest.fixture(scope="module")
+def tok():
+    return ConversationTokenizer(assistant_loss_weight=2.0)
+
+
+CONV = {
+    "messages": [
+        {"role": "system", "content": "be helpful"},
+        {"role": "user", "content": "hi there"},
+        {"role": "assistant", "content": "hello!"},
+    ]
+}
+
+
+# -- tokenizer -------------------------------------------------------------
+def test_round_trip(tok):
+    enc = tok.encode_conversation(CONV)
+    text = tok.decode(enc["input_ids"])
+    assert "be helpful" in text and "hi there" in text and "hello!" in text
+
+
+def test_assistant_mask_only_covers_assistant_tokens(tok):
+    enc = tok.encode_conversation(CONV)
+    ids, mask, w = enc["input_ids"], enc["loss_mask"], enc["loss_weights"]
+    # Masked positions decode to exactly the assistant content (+ stop tag
+    # + final eos, which carry weight so the model learns to stop).
+    masked = ids[mask > 0]
+    special = {v for v in tok.special_tokens.values()}
+    content = tok.decode([t for t in masked if t not in special])
+    assert content == "hello!"
+    assert np.all(w[mask > 0] == 2.0)
+    assert np.all(w[mask == 0] == 1.0)  # neutral weight where masked out
+
+
+def test_validation_rejects_garbage(tok):
+    assert tok.encode_conversation({"messages": []}) is None
+    assert tok.encode_conversation({"messages": [{"role": "x", "content": "y"}]}) is None
+    assert tok.stats.validation_errors >= 2
+
+
+def test_truncation_strategies(tok):
+    long_conv = {
+        "messages": [{"role": "user", "content": "a" * 500},
+                     {"role": "assistant", "content": "b" * 500}]
+    }
+    for strat in ("right", "left", "middle"):
+        enc = tok.encode_conversation(
+            long_conv, max_length=64, truncation_strategy=strat
+        )
+        assert enc["input_ids"].shape[0] == 64, strat
+        assert tok.special_tokens["<|truncated|>"] in enc["input_ids"]
+
+
+def test_padding_and_vocab_alignment(tok):
+    enc = tok.encode_conversation(CONV, pad_to_length=128)
+    assert enc["input_ids"].shape == (128,)
+    assert enc["input_ids"][-1] == tok.pad_token_id
+    assert tok.vocab_size % 128 == 0
+    assert tok.get_role_token("prompter") == tok.get_role_token("user")
+
+
+# -- native packer ----------------------------------------------------------
+def _toy_stream():
+    docs = [list(range(1, 6)), list(range(10, 22)), [7], list(range(30, 47))]
+    tokens = np.concatenate([np.asarray(d) for d in docs]).astype(np.int32)
+    offsets = np.concatenate([[0], np.cumsum([len(d) for d in docs])]).astype(np.int64)
+    return tokens, offsets
+
+
+def test_native_lib_builds():
+    assert native_available(), "C++ packer failed to build/load"
+
+
+def test_pack_batch_semantics():
+    tokens, offsets = _toy_stream()
+    out, mask, doc, tok_cur = pack_batch(
+        tokens, offsets, 0, batch=2, seq_len=8, pad_id=0, eos_id=99
+    )
+    # Row 0: doc0 (5) + eos + first 2 of doc1.
+    assert out[0].tolist() == [1, 2, 3, 4, 5, 99, 10, 11]
+    assert mask.sum() > 0 and doc >= 1
+
+
+def test_native_matches_numpy_bit_for_bit():
+    tokens, offsets = _toy_stream()
+    for eos in (-1, 99):
+        for split in (True, False):
+            a = pack_batch(tokens, offsets, 0, 2, 8, 0, eos, split,
+                           use_native=True)
+            out = np.empty((2, 8), np.int32)
+            mask = np.empty((2, 8), np.int32)
+            b = _pack_batch_numpy(
+                tokens, offsets, 0, 0, out, mask, 2, 8, 0, eos, split
+            )
+            np.testing.assert_array_equal(a[0], b[0])
+            np.testing.assert_array_equal(a[1], b[1])
+            assert a[2:] == b[2:], (eos, split)
+
+
+def test_pack_resume_cursor_covers_stream():
+    tokens, offsets = _toy_stream()
+    seen = []
+    doc = tok_cur = 0
+    while doc < len(offsets) - 1:
+        out, mask, doc, tok_cur = pack_batch(
+            tokens, offsets, doc, 1, 8, pad_id=-1, eos_id=-1,
+            start_token=tok_cur,
+        )
+        seen.extend(out[mask.astype(bool)].tolist())
+    assert seen == tokens.tolist()  # every token exactly once, in order
+
+
+def test_shuffle_indices_deterministic():
+    a = shuffle_indices(100, seed=7)
+    b = shuffle_indices(100, seed=7)
+    np.testing.assert_array_equal(a, b)
+    assert sorted(a.tolist()) == list(range(100))
+    assert not np.array_equal(a, np.arange(100))
+
+
+# -- datasets ---------------------------------------------------------------
+def write_conv_jsonl(path, n=10):
+    with open(path, "w") as f:
+        for i in range(n):
+            f.write(json.dumps({
+                "messages": [
+                    {"role": "user", "content": f"question {i}"},
+                    {"role": "assistant", "content": f"answer {i}"},
+                ]
+            }) + "\n")
+
+
+def test_conversation_dataset_and_batches(tmp_path, tok):
+    p = tmp_path / "train.jsonl"
+    write_conv_jsonl(p, n=10)
+    cfg = Config(vocab_size=tok.vocab_size, hidden_size=64, num_heads=4,
+                 num_kv_heads=2, seq_length=64, batch_size=4)
+    ds = ConversationDataset(str(p), tok, cfg)
+    assert len(ds) == 10
+    batches = list(conversation_batches(ds, batch_size=4, seed=0))
+    assert len(batches) == 2  # drop_last
+    b = batches[0]
+    assert b["input_ids"].shape == (4, 64)
+    assert set(b) == {"input_ids", "loss_mask", "loss_weights"}
+    assert ds.stats()["n_samples"] == 10
+
+
+def test_token_cache_and_packed_dataset(tmp_path, tok):
+    p = tmp_path / "corpus.jsonl"
+    with open(p, "w") as f:
+        for i in range(20):
+            f.write(json.dumps({"text": f"document number {i} " * 3}) + "\n")
+    cache = build_text_cache(str(p), str(tmp_path / "cache"), tok)
+    assert cache.n_docs == 20 and cache.n_tokens > 0
+    # Reopen from disk (no rebuild).
+    cache2 = build_text_cache(str(p), str(tmp_path / "cache"), tok)
+    assert cache2.meta["n_tokens"] == cache.meta["n_tokens"]
+
+    pd = PackedDataset(cache2, batch_size=2, seq_length=32,
+                       pad_id=tok.pad_token_id, eos_id=tok.eos_token_id)
+    batches = list(pd)
+    assert all(b["input_ids"].shape == (2, 32) for b in batches)
+    total_real = sum(int(b["loss_mask"].sum()) for b in batches)
+    assert total_real >= cache.n_tokens  # stream + eos separators
+
+
+def test_packed_dataset_shuffled_epoch(tmp_path, tok):
+    p = tmp_path / "c.jsonl"
+    with open(p, "w") as f:
+        for i in range(10):
+            f.write(json.dumps({"text": f"doc {i}"}) + "\n")
+    cache = build_text_cache(str(p), str(tmp_path / "c2"), tok)
+    plain = np.concatenate(
+        [b["input_ids"].ravel() for b in
+         PackedDataset(cache, 1, 16, shuffle_seed=None)]
+    )
+    shuf = np.concatenate(
+        [b["input_ids"].ravel() for b in
+         PackedDataset(cache, 1, 16, shuffle_seed=3)]
+    )
+    assert not np.array_equal(plain, shuf)
+
+
+def test_prefetch_loader_order_and_errors():
+    def gen():
+        for i in range(5):
+            yield {"x": np.full((2,), i)}
+
+    out = [b["x"][0] for b in PrefetchLoader(gen, prefetch=2)]
+    assert out == [0, 1, 2, 3, 4]
+
+    def bad():
+        yield {"x": np.zeros(1)}
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError, match="boom"):
+        list(PrefetchLoader(bad))

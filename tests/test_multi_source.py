@@ -1,0 +1,115 @@
+"""Multi-source pipeline + processing tests."""
+
+import json
+
+import pytest
+
+from luminaai_tpu.data.multi_source import (
+    MultiSourcePipeline,
+    SourceProcessor,
+    clean_gutenberg_text,
+    clean_html_text,
+    clean_wiki_text,
+)
+from luminaai_tpu.data.processing import (
+    create_sample_data,
+    process_oasst_data,
+    validate_data_comprehensive,
+)
+from luminaai_tpu.data.tokenizer import ConversationTokenizer
+
+
+def test_clean_wiki_text():
+    raw = ("{{Infobox|x=1}} '''Python''' is a [[programming language|language]] "
+           "created by [[Guido van Rossum]].<ref>cite</ref>\n== History ==\n"
+           "It appeared in 1991.")
+    out = clean_wiki_text(raw)
+    assert "Infobox" not in out and "[[" not in out and "<ref>" not in out
+    assert "Python is a language created by Guido van Rossum." in out
+    assert "History" in out and "==" not in out
+
+
+def test_clean_gutenberg_text():
+    raw = ("junk header\n*** START OF THE PROJECT GUTENBERG EBOOK X ***\n"
+           "Actual book text here.\n*** END OF THE PROJECT GUTENBERG EBOOK X ***\n"
+           "license junk")
+    out = clean_gutenberg_text(raw)
+    assert out == "Actual book text here."
+
+
+def test_clean_html_text():
+    raw = "<p>Use <code>print()</code> here.</p><pre><code>x = 1</code></pre>&amp; more"
+    out = clean_html_text(raw)
+    assert "`print()`" in out and "```" in out and "& more" in out
+    assert "<p>" not in out
+
+
+def test_source_processor_shards(tmp_path):
+    raw = tmp_path / "wiki_raw.jsonl"
+    with raw.open("w") as f:
+        for i in range(30):
+            f.write(json.dumps({
+                "text": f"'''Article {i}''' is about [[topic {i}]]. " * 20
+            }) + "\n")
+    proc = SourceProcessor("wikipedia")
+    shards = proc.create_dataset_files(
+        [str(raw)], str(tmp_path / "out"), num_files=2, mb_per_file=0.01
+    )
+    assert len(shards) == 2
+    recs = [json.loads(l) for l in open(shards[0])]
+    assert all(r["source"] == "wikipedia" for r in recs)
+    assert "[[" not in recs[0]["text"]
+
+
+def test_unknown_source_rejected():
+    with pytest.raises(ValueError):
+        SourceProcessor("tiktok")
+
+
+def test_blend_respects_weights_and_exhaustion(tmp_path):
+    shards = {}
+    for name, n in (("wikipedia", 30), ("arxiv", 10)):
+        p = tmp_path / f"{name}.jsonl"
+        with p.open("w") as f:
+            for i in range(n):
+                f.write(json.dumps({"text": f"{name} doc {i}", "source": name}) + "\n")
+        shards[name] = [str(p)]
+    tok = ConversationTokenizer()
+    pipe = MultiSourcePipeline(tok, {"wikipedia": 3.0, "arxiv": 1.0})
+    docs = list(pipe.iter_blended(shards, seed=0))
+    assert len(docs) == 40  # all docs surface even after a source empties
+    srcs = [d["source"] for d in docs[:20]]
+    assert srcs.count("wikipedia") > srcs.count("arxiv")
+
+    cache = pipe.build_cache(shards, str(tmp_path / "blend"))
+    assert cache.n_docs == 40
+
+
+def test_oasst_processing_and_validation(tmp_path):
+    raw = tmp_path / "oasst.jsonl"
+    with raw.open("w") as f:
+        f.write(json.dumps({"messages": [
+            {"role": "prompter", "content": "hello"},
+            {"role": "assistant", "content": "hi!"},
+        ]}) + "\n")
+        f.write(json.dumps({"messages": [
+            {"role": "prompter", "content": "only one side"},
+        ]}) + "\n")
+        f.write("not json\n")
+    out = tmp_path / "clean.jsonl"
+    n = process_oasst_data(str(raw), str(out))
+    assert n == 1
+    rec = json.loads(out.read_text().splitlines()[0])
+    assert rec["messages"][0]["role"] == "user"  # prompter normalized
+
+    report = validate_data_comprehensive(str(out), ConversationTokenizer())
+    assert report["valid"] == 1 and report["token_stats"]["mean"] > 0
+
+
+def test_create_sample_data_roundtrip(tmp_path):
+    p = tmp_path / "sample.jsonl"
+    n = create_sample_data(str(p), num_conversations=12)
+    assert n == 12
+    tok = ConversationTokenizer()
+    report = validate_data_comprehensive(str(p), tok)
+    assert report["valid"] == 12 and report["issues"]["bad_json"] == 0

@@ -163,8 +163,15 @@ def test_trainer_evolve_experts_end_to_end(tmp_path):
                 checkpoint_dir=str(tmp_path / "ckpt"))
     batch = t._put(next(patterned_data(cfg)()))
     t.state, m1 = t.train_step(t.state, batch)
+    step_before = int(t.state.step)
     assert t.evolve_experts("add_expert", reason="test")
     assert cfg.num_experts == 5
+    # Optimizer re-init must NOT reset schedule counts (warmup would replay).
+    counts = [
+        l for p, l in jax.tree_util.tree_flatten_with_path(t.state.opt_state)[0]
+        if getattr(p[-1], "name", None) == "count"
+    ]
+    assert counts and all(int(c) == step_before for c in counts)
     t.state, m2 = t.train_step(t.state, batch)  # recompiled step runs
     assert np.isfinite(float(m2["loss"]))
     assert t.evolve_experts("prune_expert", expert_idx=4, reason="test")
