@@ -1,0 +1,252 @@
+"""Interactive chat REPL over the generation engine.
+
+Covers the reference ChatInterface (ref: Src/Main_Scripts/Chat.py:472 —
+checkpoint auto-discovery :301, smart loading :132, config inference :219,
+session stats :109, commands /help /stats /mode /system /save /config :671,
+signal handling). Loading goes through orbax instead of torch.load and the
+architecture is inferred from the param tree when no config file is found.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from luminaai_tpu.config import Config
+from luminaai_tpu.data.tokenizer import ConversationTokenizer
+from luminaai_tpu.inference.generate import (
+    GenerationEngine,
+    infer_config_from_params,
+)
+
+logger = logging.getLogger(__name__)
+
+GENERATION_MODES = {
+    # (temperature, top_p) presets (ref Chat.py:741 _set_mode)
+    "creative": (1.0, 0.95),
+    "balanced": (0.8, 0.9),
+    "precise": (0.3, 0.7),
+    "deterministic": (0.0, 1.0),
+}
+
+
+@dataclasses.dataclass
+class SessionStats:
+    """(ref Chat.py:109)"""
+
+    messages: int = 0
+    total_tokens: int = 0
+    total_seconds: float = 0.0
+    started: float = dataclasses.field(default_factory=time.time)
+
+    def tokens_per_second(self) -> float:
+        return self.total_tokens / max(self.total_seconds, 1e-9)
+
+    def avg_response_time(self) -> float:
+        return self.total_seconds / max(self.messages, 1)
+
+
+def find_latest_checkpoint(
+    search_dirs: Optional[List[str]] = None,
+) -> Optional[Path]:
+    """Newest orbax checkpoint dir under common output roots
+    (ref Chat.py:301)."""
+    search_dirs = search_dirs or ["experiments", "checkpoints", "."]
+    candidates: List[Tuple[float, Path]] = []
+    for root in search_dirs:
+        rootp = Path(root)
+        if not rootp.exists():
+            continue
+        for meta in rootp.rglob("checkpoint_history.json"):
+            ckpt_dir = meta.parent
+            steps = [
+                int(p.name) for p in ckpt_dir.iterdir()
+                if p.is_dir() and p.name.isdigit()
+            ]
+            if steps:
+                candidates.append((meta.stat().st_mtime, ckpt_dir))
+    if not candidates:
+        return None
+    return max(candidates)[1]
+
+
+def load_model_for_inference(
+    checkpoint_dir: str,
+    step: Optional[int] = None,
+    config: Optional[Config] = None,
+):
+    """Restore params (+config) from an orbax checkpoint dir.
+
+    Returns (model, params, config). Config priority: explicit arg >
+    checkpoint metadata > shape inference from the param tree
+    (ref Chat.py:132 load_checkpoint_smart, :219 infer_config).
+    """
+    import jax
+    import orbax.checkpoint as ocp
+
+    from luminaai_tpu.models.transformer import LuminaTransformer
+
+    ckpt = Path(checkpoint_dir).absolute()
+    with ocp.CheckpointManager(ckpt) as mngr:
+        if step is None:
+            step = mngr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {ckpt}")
+        restored = mngr.restore(
+            step, args=ocp.args.Composite(state=ocp.args.StandardRestore())
+        )["state"]
+        params = restored["params"]
+        if config is None:
+            try:
+                meta = mngr.restore(
+                    step,
+                    args=ocp.args.Composite(metadata=ocp.args.JsonRestore()),
+                )["metadata"]
+                saved = dict(meta.get("config", {}))
+                known = {f.name for f in dataclasses.fields(Config)}
+                config = Config(
+                    **{k: v for k, v in saved.items() if k in known}
+                )
+            except Exception:
+                logger.info("no config metadata; inferring from params")
+                config = infer_config_from_params(params)
+    model = LuminaTransformer(config)
+    return model, params, config
+
+
+class ChatInterface:
+    """Terminal chat session (ref Chat.py:472)."""
+
+    def __init__(
+        self,
+        checkpoint_dir: Optional[str] = None,
+        config: Optional[Config] = None,
+        tokenizer: Optional[ConversationTokenizer] = None,
+        engine: Optional[GenerationEngine] = None,
+    ):
+        if engine is not None:
+            self.engine = engine
+            self.config = engine.config
+        else:
+            if checkpoint_dir is None:
+                found = find_latest_checkpoint()
+                if found is None:
+                    raise FileNotFoundError(
+                        "no checkpoint found; pass checkpoint_dir"
+                    )
+                checkpoint_dir = str(found)
+                logger.info("auto-discovered checkpoint: %s", checkpoint_dir)
+            model, params, config = load_model_for_inference(
+                checkpoint_dir, config=config
+            )
+            self.config = config
+            tokenizer = tokenizer or ConversationTokenizer(
+                model_name=config.tokenizer_name
+                if config.tokenizer_name in ("byte",)
+                else "byte"
+            )
+            self.engine = GenerationEngine(model, params, tokenizer, config)
+        self.tokenizer = self.engine.tokenizer
+        self.stats = SessionStats()
+        self.mode = "balanced"
+        self.system_prompt: Optional[str] = None
+        self.history: List[Dict[str, str]] = []
+
+    # -- one exchange ------------------------------------------------------
+    def respond(self, user_message: str) -> Tuple[str, Dict[str, Any]]:
+        messages: List[Dict[str, str]] = []
+        if self.system_prompt:
+            messages.append({"role": "system", "content": self.system_prompt})
+        messages.extend(self.history)
+        messages.append({"role": "user", "content": user_message})
+        temperature, top_p = GENERATION_MODES[self.mode]
+        text, gen_stats = self.engine.chat_response(
+            messages, temperature=temperature, top_p=top_p
+        )
+        self.history.append({"role": "user", "content": user_message})
+        self.history.append({"role": "assistant", "content": text})
+        self.stats.messages += 1
+        self.stats.total_tokens += gen_stats["tokens_generated"]
+        self.stats.total_seconds += gen_stats["seconds"]
+        return text, gen_stats
+
+    # -- commands (ref Chat.py:671) ---------------------------------------
+    def handle_command(self, command: str) -> Optional[str]:
+        """Returns output text, or None if the REPL should exit."""
+        parts = command.strip().split(maxsplit=1)
+        cmd = parts[0].lower()
+        arg = parts[1] if len(parts) > 1 else ""
+        if cmd in ("/quit", "/exit"):
+            return None
+        if cmd == "/help":
+            return (
+                "/help /stats /mode <name> /system <prompt> /clear "
+                "/save <name> /config /quit\n"
+                f"modes: {', '.join(GENERATION_MODES)}"
+            )
+        if cmd == "/stats":
+            s = self.stats
+            return (
+                f"messages: {s.messages}  tokens: {s.total_tokens}  "
+                f"tok/s: {s.tokens_per_second():.1f}  "
+                f"avg response: {s.avg_response_time():.2f}s"
+            )
+        if cmd == "/mode":
+            if arg in GENERATION_MODES:
+                self.mode = arg
+                return f"mode -> {arg}"
+            return f"unknown mode {arg!r}; one of {list(GENERATION_MODES)}"
+        if cmd == "/system":
+            self.system_prompt = arg or None
+            return "system prompt " + ("set" if arg else "cleared")
+        if cmd == "/clear":
+            self.history.clear()
+            return "history cleared"
+        if cmd == "/save":
+            name = arg or f"conversation_{int(time.time())}"
+            path = Path(f"{name}.json")
+            path.write_text(json.dumps({
+                "history": self.history,
+                "system_prompt": self.system_prompt,
+                "stats": dataclasses.asdict(self.stats),
+            }, indent=1))
+            return f"saved -> {path}"
+        if cmd == "/config":
+            c = self.config
+            return (
+                f"model: {c.num_layers}L x {c.hidden_size}h, "
+                f"{c.num_heads}/{c.num_kv_heads} heads, "
+                f"moe={c.num_experts if c.use_moe else 'off'}, "
+                f"vocab={c.vocab_size}, ctx={c.seq_length}"
+            )
+        return f"unknown command {cmd!r}; try /help"
+
+    # -- REPL --------------------------------------------------------------
+    def run(self) -> None:  # pragma: no cover - interactive
+        print("LuminaAI-TPU chat. /help for commands, /quit to exit.")
+        while True:
+            try:
+                user = input("\nyou> ").strip()
+            except (EOFError, KeyboardInterrupt):
+                print()
+                break
+            if not user:
+                continue
+            if user.startswith("/"):
+                out = self.handle_command(user)
+                if out is None:
+                    break
+                print(out)
+                continue
+            text, gen_stats = self.respond(user)
+            print(f"\nassistant> {text}")
+            print(
+                f"  [{gen_stats['tokens_generated']} tokens, "
+                f"{gen_stats['tokens_per_second']} tok/s]"
+            )
