@@ -1,50 +1,110 @@
-"""Benchmark harness (driver contract: ONE JSON line on stdout).
+"""Benchmark harness (driver contract: exactly ONE JSON line on stdout).
 
 North-star metric (SURVEY.md §6 / BASELINE.json): training tokens/sec/chip
 on the 8-expert top-2 MoE config (capacity 1.25, aux 0.01), bf16, full train
 step (fwd + bwd + optimizer). vs_baseline compares against the reference's
-headline debug-MoE figure (59.5k tok/s, BENCHMARKS.md consumer-GPU number —
-the only published absolute throughput for this model family).
+headline debug-MoE figure (59.5k tok/s, /root/reference/BENCHMARKS.md "MoE
+Configuration (8 experts, top-2)" — the only published absolute throughput
+for this model family).
+
+Robustness contract (VERDICT r1 weak #2): the parent process imports NO jax.
+It probes the backend in a subprocess with a timeout, runs the real bench in
+a child with a timeout, retries on crash with a smaller config, falls back
+to CPU, and ALWAYS prints one parseable JSON line — with an "error" field
+when every rung fails — so the round artifact is always diagnosable.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
 REF_MOE_TOKENS_PER_SEC = 59_500.0
+METRIC = "train_tokens_per_sec_per_chip_moe8x2"
+
+# TPU v5e bf16 peak per chip. Used for MFU; other platforms report mfu=null.
+TPU_PEAK_FLOPS = 197e12
+
+# (name, timeout_s). Each rung is tried in order until one emits valid JSON.
+LADDER = [
+    ("flagship", 1500),
+    ("flagship_small", 600),
+    ("cpu_fallback", 420),
+]
 
 
-def main() -> None:
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-
+def _child_config(name: str, n_chips: int = 1):
+    """Bench configs. flagship: ~757M total / ~238M active MoE, sized to
+    saturate the MXU on one v5e chip (state ~9GB of 16GB HBM). Batch scales
+    with chip count so per-chip load is constant across slice sizes."""
     from luminaai_tpu.config import Config
-    from luminaai_tpu.models.transformer import LuminaTransformer
-    from luminaai_tpu.parallel.mesh import build_mesh
-    from luminaai_tpu.parallel.sharding import init_sharded_state
-    from luminaai_tpu.parallel.train_step import make_train_step
-    from luminaai_tpu.training.optimizer import make_optimizer, make_schedule
 
-    n_chips = jax.device_count()
-    cfg = Config(
-        vocab_size=32768,
-        hidden_size=512,
-        num_layers=8,
-        num_heads=8,
-        num_kv_heads=4,
-        seq_length=1024,
-        batch_size=16 * n_chips,
+    if name in ("flagship", "flagship_small"):
+        return Config(
+            vocab_size=32768,
+            hidden_size=1024,
+            num_layers=10,
+            num_heads=16,
+            num_kv_heads=8,
+            seq_length=2048,
+            batch_size=(16 if name == "flagship" else 8) * n_chips,
+            use_moe=True,
+            num_experts=8,
+            moe_top_k=2,
+            capacity_factor=1.25,
+            load_balancing_weight=0.01,
+            precision="bf16",
+            use_flash_attention=True,
+            gradient_checkpointing=True,
+        )
+    # cpu_fallback: tiny model so a flaky/absent TPU still yields a number
+    # (flagged via extras.platform + error note; vs_baseline not meaningful).
+    return Config(
+        vocab_size=2048,
+        hidden_size=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        seq_length=256,
+        batch_size=8,
         use_moe=True,
         num_experts=8,
         moe_top_k=2,
         capacity_factor=1.25,
         load_balancing_weight=0.01,
-        precision="bf16",
+        precision="fp32",
+        use_flash_attention=False,
         gradient_checkpointing=False,
     )
+
+
+def _child_main(name: str) -> None:
+    """Runs in a subprocess; prints the JSON result line on success."""
+    import jax
+
+    if name == "cpu_fallback":
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from luminaai_tpu.models.transformer import LuminaTransformer
+    from luminaai_tpu.parallel.mesh import build_mesh
+    from luminaai_tpu.parallel.sharding import init_sharded_state
+    from luminaai_tpu.parallel.train_step import make_train_step
+    from luminaai_tpu.training.optimizer import make_optimizer, make_schedule
+    from luminaai_tpu.training.scaler import ComputeEfficiencyTracker
+
+    n_chips = jax.device_count()
+    platform = jax.devices()[0].platform
+    cfg = _child_config(name, n_chips)
+    if platform != "tpu":
+        # Pallas flash + bf16 matmuls are TPU-shaped; keep CPU runs honest.
+        cfg.use_flash_attention = False
+
     model = LuminaTransformer(cfg)
     schedule = make_schedule(cfg, 1000)
     tx = make_optimizer(cfg, 1000, schedule)
@@ -57,12 +117,17 @@ def main() -> None:
     )
     batch = {"input_ids": jnp.asarray(ids, jnp.int32)}
 
-    # Warmup: compile + one executed step.
-    for _ in range(2):
-        state, metrics = step(state, batch)
+    # First step = compile + execute; measured separately.
+    t0 = time.perf_counter()
+    state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    compile_s = time.perf_counter() - t0
+
+    # Warmup one more executed step so caches/donation settle.
+    state, metrics = step(state, batch)
     jax.block_until_ready(metrics["loss"])
 
-    steps = 20
+    steps = 20 if name != "cpu_fallback" else 5
     t0 = time.perf_counter()
     for _ in range(steps):
         state, metrics = step(state, batch)
@@ -71,21 +136,130 @@ def main() -> None:
 
     tokens = steps * cfg.batch_size * cfg.seq_length
     tps_chip = tokens / dt / n_chips
+    tracker = ComputeEfficiencyTracker(
+        active_params=cfg.estimate_active_parameters(),
+        n_chips=n_chips,
+        peak_flops=TPU_PEAK_FLOPS,
+    )
+    sample = tracker.record(tokens, dt)
+    mfu = round(sample["mfu"], 4) if platform == "tpu" else None
+
     result = {
-        "metric": "train_tokens_per_sec_per_chip_moe8x2",
+        "metric": METRIC,
         "value": round(tps_chip, 1),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(tps_chip / REF_MOE_TOKENS_PER_SEC, 3),
         "extras": {
             "chips": n_chips,
+            "platform": platform,
+            "config": name,
+            "total_params_m": round(cfg.estimate_parameters() / 1e6, 1),
+            "active_params_m": round(cfg.estimate_active_parameters() / 1e6, 1),
+            "batch": cfg.batch_size,
+            "seq": cfg.seq_length,
+            "mfu": mfu,
+            "model_tflops_per_sec": round(sample["tflops_per_sec"], 2),
             "loss": round(float(metrics["loss"]), 4),
             "moe_drop_rate": round(float(metrics.get("moe_drop_rate", 0.0)), 4),
             "step_ms": round(dt / steps * 1e3, 2),
-            "platform": jax.devices()[0].platform,
+            "compile_s": round(compile_s, 1),
         },
     }
+    if platform != "tpu":
+        result["extras"]["note"] = "tpu_unavailable_cpu_fallback"
     print(json.dumps(result))
 
 
+def _probe_backend(timeout: int = 90, tries: int = 2):
+    """Initialize the default backend in a throwaway process (it can hang —
+    hence subprocess + timeout) and report its platform, or None."""
+    code = "import jax; print(jax.device_count(), jax.devices()[0].platform)"
+    for i in range(tries):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True,
+                text=True,
+                timeout=timeout,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            if proc.returncode == 0:
+                parts = proc.stdout.split()
+                return parts[1] if len(parts) >= 2 else "unknown"
+        except subprocess.TimeoutExpired:
+            pass
+        time.sleep(5 * (i + 1))
+    return None
+
+
+def _run_child(name: str, timeout: int):
+    """Run one ladder rung; returns (parsed_json | None, diagnostic_str)."""
+    env = dict(os.environ)
+    if name == "cpu_fallback":
+        env["JAX_PLATFORMS"] = "cpu"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child", name],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"{name}: timeout after {timeout}s"
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+                if parsed.get("metric") == METRIC:
+                    return parsed, f"{name}: ok"
+            except json.JSONDecodeError:
+                pass
+    return None, f"{name}: rc={proc.returncode} stderr={proc.stderr[-500:]!r}"
+
+
+def main() -> None:
+    diagnostics = []
+    platform = _probe_backend()
+    diagnostics.append(f"backend_probe={platform or 'failed'}")
+
+    # The flagship rungs only make sense on a real accelerator; a missing
+    # TPU silently initializes as CPU, where a ~757M model would just burn
+    # the timeout — jump straight to the fallback rung there.
+    ladder = LADDER if platform == "tpu" else [("cpu_fallback", 420)]
+    for name, timeout in ladder:
+        result, diag = _run_child(name, timeout)
+        diagnostics.append(diag)
+        if result is not None:
+            extras = result.setdefault("extras", {})
+            if platform != "tpu":
+                extras["note"] = (
+                    f"tpu_unavailable(probe={platform})_cpu_fallback"
+                )
+            elif extras.get("config") == "cpu_fallback":
+                # TPU was there but both flagship rungs died — say so
+                # instead of letting the child's note claim it was absent.
+                extras["note"] = "flagship_failed_on_tpu_cpu_fallback"
+                extras["ladder_diag"] = "; ".join(diagnostics)[-800:]
+            print(json.dumps(result))
+            return
+    print(
+        json.dumps(
+            {
+                "metric": METRIC,
+                "value": 0.0,
+                "unit": "tokens/sec/chip",
+                "vs_baseline": 0.0,
+                "error": "; ".join(diagnostics)[-1500:],
+            }
+        )
+    )
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    if len(sys.argv) >= 3 and sys.argv[1] == "--child":
+        _child_main(sys.argv[2])
+    else:
+        main()

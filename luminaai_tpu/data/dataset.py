@@ -222,24 +222,11 @@ class PackedDataset:
         return max(1, self.cache.n_tokens // per_batch)
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        if self.shuffle_seed is not None:
+            yield from self._iter_shuffled()
+            return
         offsets = self.cache.offsets
         tokens = self.cache.tokens
-        if self.shuffle_seed is not None:
-            # Shuffle documents by reordering the offset walk: build a
-            # permuted (tokens, offsets) view once per epoch.
-            perm = shuffle_indices(self.cache.n_docs, self.shuffle_seed)
-            lengths = (offsets[1:] - offsets[:-1])[perm]
-            new_offsets = np.concatenate(
-                [[0], np.cumsum(lengths)]
-            ).astype(np.int64)
-            gather = np.concatenate(
-                [
-                    np.arange(offsets[d], offsets[d + 1])
-                    for d in perm
-                ]
-            ) if self.cache.n_docs else np.empty(0, np.int64)
-            tokens = np.asarray(tokens)[gather]
-            offsets = new_offsets
         doc, tok = 0, 0
         n_docs = len(offsets) - 1
         while doc < n_docs:
@@ -255,6 +242,63 @@ class PackedDataset:
                 "input_ids": out,
                 "loss_mask": mask.astype(np.float32),
             }
+
+    def _iter_shuffled(self) -> Iterator[Dict[str, np.ndarray]]:
+        """Document-shuffled epoch with bounded host memory.
+
+        Walks the permuted doc order through a sliding window of per-doc
+        slices copied from the memmap — never materializing the corpus
+        (the old gather-everything path OOM'd on multi-GB caches). The
+        window holds just enough docs for one full batch plus the carry
+        of a split doc, so peak memory is O(batch·seq + longest doc).
+        """
+        offsets = self.cache.offsets
+        tokens = self.cache.tokens
+        perm = shuffle_indices(self.cache.n_docs, self.shuffle_seed)
+        need = self.batch_size * (self.seq_length + 1)
+        buf_docs: List[np.ndarray] = []
+        buf_tokens = 0
+        pi = 0
+        while True:
+            while buf_tokens < need and pi < len(perm):
+                d = int(perm[pi])
+                pi += 1
+                arr = np.asarray(tokens[offsets[d]:offsets[d + 1]])
+                if arr.size:
+                    buf_docs.append(arr)
+                    buf_tokens += arr.size
+            if not buf_docs:
+                break
+            cat = (
+                np.concatenate(buf_docs) if len(buf_docs) > 1 else buf_docs[0]
+            )
+            local_offsets = np.concatenate(
+                [[0], np.cumsum([a.size for a in buf_docs])]
+            ).astype(np.int64)
+            out, mask, next_doc, next_tok = pack_batch(
+                cat, local_offsets, 0,
+                self.batch_size, self.seq_length,
+                pad_id=self.pad_id, eos_id=self.eos_id,
+                split_docs=True, start_token=0,
+            )
+            if mask.sum() == 0:
+                break
+            yield {
+                "input_ids": out,
+                "loss_mask": mask.astype(np.float32),
+            }
+            # Carry unconsumed docs (the tail of a split doc re-enters as a
+            # fresh doc head, preserving eos-at-doc-end semantics).
+            rest: List[np.ndarray] = []
+            if next_doc < len(buf_docs):
+                head = buf_docs[next_doc][next_tok:]
+                if head.size:
+                    rest.append(head)
+                rest.extend(buf_docs[next_doc + 1:])
+            buf_docs = rest
+            buf_tokens = sum(a.size for a in buf_docs)
+            if not buf_docs and pi >= len(perm):
+                break
 
 
 # ---------------------------------------------------------------------------
@@ -281,25 +325,43 @@ class PrefetchLoader:
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         error: List[BaseException] = []
+        stop = threading.Event()
+
+        def put(item) -> bool:
+            # Bounded put that aborts when the consumer is gone, so an
+            # abandoned iterator (early stop, rollback) can't strand the
+            # worker blocked on a full queue with its file handle open.
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def worker():
             try:
                 for b in self.batch_fn():
-                    q.put(b)
+                    if not put(b):
+                        return
             except BaseException as e:  # pragma: no cover - propagated below
                 error.append(e)
             finally:
-                q.put(self._DONE)
+                put(self._DONE)
 
         t = threading.Thread(target=worker, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is self._DONE:
-                break
-            yield item
-        if error:
-            raise error[0]
+        try:
+            while True:
+                item = q.get()
+                if item is self._DONE:
+                    break
+                yield item
+            if error:
+                raise error[0]
+        finally:
+            stop.set()
+            t.join(timeout=5.0)
 
 
 # ---------------------------------------------------------------------------

@@ -236,8 +236,14 @@ class ConversationTokenizer:
             turn_w = [0.0, 0.0, *([w] * len(body)), w]
             tokens.extend(turn)
             weights.extend(turn_w)
+        # Trailing EOS trains only when the conversation actually ends on
+        # an assistant turn; otherwise (user/system-final multi-turn data)
+        # weighting it would teach the model to emit EOS right after user
+        # prompts.
+        msgs = conversation.get("messages", [])
+        ends_on_assistant = bool(msgs) and msgs[-1].get("role") in ASSISTANT_ROLES
         tokens.append(self.eos_token_id)
-        weights.append(self.assistant_loss_weight)
+        weights.append(self.assistant_loss_weight if ends_on_assistant else 0.0)
 
         if len(tokens) > max_length:
             tokens, weights = self._truncate(

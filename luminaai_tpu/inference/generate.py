@@ -181,7 +181,7 @@ class GenerationEngine:
             state = jax.lax.while_loop(
                 cond, functools.partial(body, params), state
             )
-            return state[6], state[0]
+            return state[6], state[0], state[5]
 
         return jax.jit(decode)
 
@@ -240,19 +240,24 @@ class GenerationEngine:
             self.tokenizer.eos_token_id, self.tokenizer.pad_token_id,
             self.tokenizer.im_end,
         }
-        if int(first_token) in stop_set or max_new <= 1:
-            return [], {
-                "tokens_generated": 0,
-                "seconds": time.time() - t0,
-                "tokens_per_second": 0.0,
+        first_is_stop = int(first_token) in stop_set
+        if first_is_stop or max_new <= 1:
+            # A stop token is dropped; a normal token under a 1-token
+            # budget is a valid result that exhausted the length.
+            tokens = [] if first_is_stop else [int(first_token)]
+            dt = time.time() - t0
+            return tokens, {
+                "tokens_generated": len(tokens),
+                "seconds": round(dt, 3),
+                "tokens_per_second": round(len(tokens) / max(dt, 1e-9), 1),
                 "prompt_tokens": length,
-                "stopped": "eos",
+                "stopped": "eos" if first_is_stop else "length",
             }
 
         counts = counts.at[first_token].add(1)
         if gen_key not in self._decode_fn:
             self._decode_fn[gen_key] = self._make_decode(gen_key)
-        out, n = self._decode_fn[gen_key](
+        out, n, hit_stop = self._decode_fn[gen_key](
             self.params, rng, first_token, caches, counts,
             jnp.asarray(length, jnp.int32),
         )
@@ -265,7 +270,9 @@ class GenerationEngine:
             "seconds": round(dt, 3),
             "tokens_per_second": round(len(tokens) / max(dt, 1e-9), 1),
             "prompt_tokens": length,
-            "stopped": "eos" if n < max_new else "length",
+            # The loop's own done flag distinguishes eos-on-last-step from
+            # genuine length exhaustion (both return n == max_new - 1).
+            "stopped": "eos" if bool(hit_stop) else "length",
         }
         return tokens, stats
 

@@ -63,6 +63,27 @@ def shift_labels(batch: Batch) -> Tuple[jax.Array, jax.Array]:
     return labels, valid
 
 
+def shift_with_labels(x: jax.Array) -> jax.Array:
+    """Left-shift a per-position tensor so index i refers to the PREDICTED
+    token (ids[i+1]), matching shift_labels. loss_mask/loss_weights arrive
+    aligned to input positions; the loss at position i is for predicting
+    token i+1, so its gate/weight must come from position i+1 (ref
+    core/dataset.py:505-507 shifts labels[1:] and loss_weights[1:] together).
+    """
+    return jnp.concatenate([x[:, 1:], jnp.zeros_like(x[:, :1])], axis=1)
+
+
+def _shifted_mask_weights(
+    batch: Batch, valid: jax.Array
+) -> Tuple[jax.Array, Optional[jax.Array]]:
+    loss_mask = batch.get("loss_mask")
+    mask = valid if loss_mask is None else valid * shift_with_labels(loss_mask)
+    weights = batch.get("loss_weights")
+    if weights is not None:
+        weights = shift_with_labels(weights)
+    return mask, weights
+
+
 def make_loss_fn(config: Config, model) -> Callable:
     def loss_fn(params, batch: Batch, rng: jax.Array):
         rngs = {"routing": rng, "dropout": jax.random.fold_in(rng, 1)}
@@ -73,13 +94,12 @@ def make_loss_fn(config: Config, model) -> Callable:
             rngs=rngs,
         )
         labels, valid = shift_labels(batch)
-        loss_mask = batch.get("loss_mask")
-        mask = valid if loss_mask is None else valid * loss_mask
+        mask, weights = _shifted_mask_weights(batch, valid)
         loss, metrics = cross_entropy_loss(
             logits,
             labels,
             loss_mask=mask,
-            loss_weights=batch.get("loss_weights"),
+            loss_weights=weights,
             z_loss_weight=config.z_loss_weight,
             label_smoothing=config.label_smoothing,
         )
@@ -195,11 +215,9 @@ def make_eval_step(
             {"params": params}, batch["input_ids"], deterministic=True
         )
         labels, valid = shift_labels(batch)
-        loss_mask = batch.get("loss_mask")
-        mask = valid if loss_mask is None else valid * loss_mask
+        mask, weights = _shifted_mask_weights(batch, valid)
         loss, metrics = cross_entropy_loss(
-            logits, labels, loss_mask=mask,
-            loss_weights=batch.get("loss_weights"),
+            logits, labels, loss_mask=mask, loss_weights=weights,
         )
         for k, v in aux.items():
             metrics[k] = v

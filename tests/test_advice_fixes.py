@@ -1,0 +1,228 @@
+"""Regression tests for round-1 advisor findings (ADVICE.md):
+
+- loss_mask/loss_weights must be shifted with the labels so the loss for
+  predicting token i+1 is gated by token i+1's mask, not token i's.
+- Trailing EOS gets assistant weight only when the conversation ends on an
+  assistant turn.
+- PackedDataset's shuffled epoch must not materialize the corpus and must
+  produce the same batches as packing the fully materialized permuted
+  stream.
+- PrefetchLoader must release its worker thread when the consumer abandons
+  the iterator early.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from luminaai_tpu.config import Config
+from luminaai_tpu.data.dataset import PackedDataset, PrefetchLoader, TokenCache
+from luminaai_tpu.data.tokenizer import ConversationTokenizer
+from luminaai_tpu.models.transformer import LuminaTransformer
+from luminaai_tpu.native import pack_batch, shuffle_indices
+from luminaai_tpu.ops.fused import cross_entropy_loss
+from luminaai_tpu.parallel.train_step import make_loss_fn, shift_with_labels
+
+
+# -- loss mask/weight alignment -------------------------------------------
+def _tiny_model():
+    cfg = Config(
+        vocab_size=64,
+        hidden_size=32,
+        num_layers=1,
+        num_heads=2,
+        num_kv_heads=2,
+        seq_length=16,
+        batch_size=2,
+        use_moe=False,
+        use_flash_attention=False,
+        gradient_checkpointing=False,
+        precision="fp32",
+        z_loss_weight=0.0,
+        label_smoothing=0.0,
+        dropout=0.0,
+    )
+    model = LuminaTransformer(cfg)
+    ids = jnp.arange(cfg.batch_size * cfg.seq_length, dtype=jnp.int32)
+    ids = ids.reshape(cfg.batch_size, cfg.seq_length) % cfg.vocab_size
+    params = model.init(jax.random.key(0), ids)["params"]
+    return cfg, model, params, ids
+
+
+def test_shift_with_labels_moves_left_and_zeroes_tail():
+    x = jnp.asarray([[1.0, 2.0, 3.0, 4.0]])
+    out = shift_with_labels(x)
+    assert out.tolist() == [[2.0, 3.0, 4.0, 0.0]]
+
+
+def test_loss_mask_gates_predicted_token_position():
+    """A mask marking only token j must yield the CE of logits[j-1]
+    predicting ids[j] — i.e. the mask follows the label shift."""
+    cfg, model, params, ids = _tiny_model()
+    j = 5
+    loss_mask = np.zeros((cfg.batch_size, cfg.seq_length), np.float32)
+    loss_mask[:, j] = 1.0
+    batch = {"input_ids": ids, "loss_mask": jnp.asarray(loss_mask)}
+
+    loss_fn = make_loss_fn(cfg, model)
+    loss, _ = loss_fn(params, batch, jax.random.key(1))
+
+    logits, _ = model.apply({"params": params}, ids, deterministic=True)
+    logp = jax.nn.log_softmax(logits[:, j - 1].astype(jnp.float32), axis=-1)
+    expected = -jnp.take_along_axis(
+        logp, ids[:, j][:, None], axis=-1
+    ).mean()
+    np.testing.assert_allclose(float(loss), float(expected), rtol=1e-4)
+
+
+def test_loss_weights_follow_label_shift():
+    """Weighting token j by w must scale exactly the loss term for
+    predicting ids[j] (at logits position j-1)."""
+    cfg, model, params, ids = _tiny_model()
+    loss_fn = make_loss_fn(cfg, model)
+    base_mask = np.ones((cfg.batch_size, cfg.seq_length), np.float32)
+
+    weights = np.ones((cfg.batch_size, cfg.seq_length), np.float32)
+    j = 7
+    weights[:, j] = 3.0
+    rng = jax.random.key(1)
+    loss_w, _ = loss_fn(
+        params,
+        {
+            "input_ids": ids,
+            "loss_mask": jnp.asarray(base_mask),
+            "loss_weights": jnp.asarray(weights),
+        },
+        rng,
+    )
+    loss_u, _ = loss_fn(
+        params,
+        {"input_ids": ids, "loss_mask": jnp.asarray(base_mask)},
+        rng,
+    )
+    # Compute the per-position CE at j-1 (predicting ids[j]) directly.
+    logits, _ = model.apply({"params": params}, ids, deterministic=True)
+    logp = jax.nn.log_softmax(logits[:, j - 1].astype(jnp.float32), axis=-1)
+    ce_j = -jnp.take_along_axis(logp, ids[:, j][:, None], axis=-1)[:, 0]
+    n = cfg.batch_size * (cfg.seq_length - 1)  # valid loss positions
+    # weighted mean = (sum_u + 2*sum(ce_j)) / (n + 2*batch)
+    expected = (float(loss_u) * n + 2.0 * float(ce_j.sum())) / (
+        n + 2.0 * cfg.batch_size
+    )
+    np.testing.assert_allclose(float(loss_w), expected, rtol=1e-4)
+
+
+# -- trailing EOS weight ----------------------------------------------------
+def test_trailing_eos_weight_follows_final_role():
+    tok = ConversationTokenizer(assistant_loss_weight=2.0)
+    ends_user = {
+        "messages": [
+            {"role": "assistant", "content": "hi"},
+            {"role": "user", "content": "tell me more"},
+        ]
+    }
+    enc = tok.encode_conversation(ends_user)
+    assert enc["input_ids"][-1] == tok.eos_token_id
+    assert enc["loss_mask"][-1] == 0.0  # EOS after a user turn: no loss
+
+    ends_assistant = {
+        "messages": [
+            {"role": "user", "content": "hi"},
+            {"role": "assistant", "content": "hello"},
+        ]
+    }
+    enc = tok.encode_conversation(ends_assistant)
+    assert enc["input_ids"][-1] == tok.eos_token_id
+    assert enc["loss_mask"][-1] == 1.0
+    assert enc["loss_weights"][-1] == 2.0
+
+
+# -- shuffled packing equivalence ------------------------------------------
+def _make_cache(tmp_path, n_docs=37, seed=3):
+    rng = np.random.RandomState(seed)
+    docs = [
+        rng.randint(1, 100, size=rng.randint(3, 40)).tolist()
+        for _ in range(n_docs)
+    ]
+    return TokenCache(str(tmp_path / "c")).build(iter(docs))
+
+
+def test_shuffled_packing_matches_materialized_reference(tmp_path):
+    cache = _make_cache(tmp_path)
+    B, S, SEED = 4, 16, 11
+    ds = PackedDataset(
+        cache, batch_size=B, seq_length=S, pad_id=0, eos_id=1,
+        shuffle_seed=SEED,
+    )
+    got = list(ds)
+
+    # Reference: materialize the permuted stream, pack in one walk (the
+    # old O(corpus) behavior we are matching without the memory cost).
+    perm = shuffle_indices(cache.n_docs, SEED)
+    toks = np.concatenate(
+        [np.asarray(cache.tokens[cache.offsets[d]:cache.offsets[d + 1]])
+         for d in perm]
+    )
+    lens = (cache.offsets[1:] - cache.offsets[:-1])[perm]
+    offs = np.concatenate([[0], np.cumsum(lens)]).astype(np.int64)
+    want = []
+    doc, tok = 0, 0
+    while doc < cache.n_docs:
+        out, mask, doc, tok = pack_batch(
+            toks, offs, doc, B, S, pad_id=0, eos_id=1,
+            split_docs=True, start_token=tok,
+        )
+        if mask.sum() == 0:
+            break
+        want.append((out, mask))
+
+    assert len(got) == len(want)
+    for g, (w_out, w_mask) in zip(got, want):
+        np.testing.assert_array_equal(g["input_ids"], w_out)
+        np.testing.assert_array_equal(g["loss_mask"], w_mask.astype(np.float32))
+
+
+def test_shuffled_packing_covers_all_tokens(tmp_path):
+    cache = _make_cache(tmp_path, n_docs=20)
+    ds = PackedDataset(
+        cache, batch_size=2, seq_length=32, pad_id=0, eos_id=-1,
+        shuffle_seed=7,
+    )
+    real = sum(int(b["loss_mask"].sum()) for b in ds)
+    # every corpus token appears exactly once (no eos inserted, pad excluded),
+    # except a possible dropped tail shorter than one row
+    assert cache.n_tokens - real < 2 * 32
+
+
+# -- prefetch loader abandonment -------------------------------------------
+def test_prefetch_abandoned_iterator_releases_worker():
+    def slow_batches():
+        for i in range(1000):
+            yield {"input_ids": np.zeros((1, 4), np.int32) + i}
+
+    before = threading.active_count()
+    loader = PrefetchLoader(slow_batches, prefetch=1)
+    it = iter(loader)
+    first = next(it)
+    assert int(first["input_ids"][0, 0]) == 0
+    it.close()  # abandon mid-epoch; finally must stop the worker
+    deadline = time.time() + 5.0
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before
+
+
+def test_prefetch_full_epoch_still_complete():
+    n = 17
+
+    def batches():
+        for i in range(n):
+            yield {"x": np.asarray([i])}
+
+    out = list(PrefetchLoader(batches, prefetch=3))
+    assert [int(b["x"][0]) for b in out] == list(range(n))
