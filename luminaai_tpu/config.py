@@ -467,6 +467,7 @@ class ConfigPresets:
             moe_top_k=2,
             fsdp_parallel_size=8,
             expert_parallel_size=8,
+            scan_layers=True,
             experiment_name="b7",
         )
 
@@ -487,6 +488,7 @@ class ConfigPresets:
             moe_top_k=2,
             fsdp_parallel_size=16,
             expert_parallel_size=8,
+            scan_layers=True,
             experiment_name="b14",
         )
 
@@ -507,6 +509,7 @@ class ConfigPresets:
             moe_top_k=2,
             fsdp_parallel_size=32,
             expert_parallel_size=8,
+            scan_layers=True,
             experiment_name="b30",
         )
 
@@ -527,6 +530,7 @@ class ConfigPresets:
             moe_top_k=2,
             fsdp_parallel_size=32,
             expert_parallel_size=16,
+            scan_layers=True,
             experiment_name="b50",
         )
 
@@ -549,6 +553,7 @@ class ConfigPresets:
             expert_parallel_size=16,
             use_ring_attention=True,
             sequence_parallel_size=1,
+            scan_layers=True,
             experiment_name="b75",
         )
 
@@ -570,6 +575,7 @@ class ConfigPresets:
             fsdp_parallel_size=64,
             expert_parallel_size=32,
             use_ring_attention=True,
+            scan_layers=True,
             experiment_name="b100",
         )
 
@@ -591,6 +597,7 @@ class ConfigPresets:
             fsdp_parallel_size=128,
             expert_parallel_size=64,
             use_ring_attention=True,
+            scan_layers=True,
             experiment_name="b200",
         )
 
@@ -613,6 +620,7 @@ class ConfigPresets:
             expert_parallel_size=64,
             tensor_parallel_size=2,
             use_ring_attention=True,
+            scan_layers=True,
             experiment_name="b300",
         )
 

@@ -292,9 +292,34 @@ class GenerationEngine:
         return tok.decode(tokens), stats
 
 
+def _per_layer_view(params: Dict[str, Any]) -> Tuple[Dict[str, Any], bool]:
+    """Flatten a scanned ('scan_{s}/block_{j}', leading scan axis) param
+    tree into the per-layer 'layer_{i}' view. Layer order is recoverable
+    without a Config: segments are numbered in stack order and each one is
+    `count` repetitions of its block_0..block_{u-1} unit."""
+    scan_keys = [k for k in params if k.startswith("scan_")]
+    if not scan_keys:
+        return params, False
+    out = {k: v for k, v in params.items() if not k.startswith("scan_")}
+    idx = 0
+    for sk in sorted(scan_keys, key=lambda k: int(k.split("_")[1])):
+        seg = params[sk]
+        blocks = sorted(seg.keys(), key=lambda k: int(k.split("_")[1]))
+        count = jax.tree.leaves(seg[blocks[0]])[0].shape[0]
+        for rep in range(count):
+            for b in blocks:
+                out[f"layer_{idx}"] = jax.tree.map(
+                    lambda x, rep=rep: x[rep], seg[b]
+                )
+                idx += 1
+    return out, True
+
+
 def infer_config_from_params(params: Dict[str, Any]) -> Config:
-    """Reconstruct an architecture Config from a param tree
-    (ref Chat.py:219 infer_config_from_state_dict)."""
+    """Reconstruct an architecture Config from a param tree, in either the
+    per-layer or the scanned layout (ref Chat.py:219
+    infer_config_from_state_dict)."""
+    params, was_scanned = _per_layer_view(params)
     emb = params["embedder"]["embedding"]
     vocab, hidden = emb.shape
     layers = sorted(
@@ -324,8 +349,16 @@ def infer_config_from_params(params: Dict[str, Any]) -> Config:
             kw["moe_pattern"] = "every_3rd"
         elif all(i % 4 == 3 for i in moe_layers):
             kw["moe_pattern"] = "every_4th"
+        elif moe_layers == list(
+            range(moe_layers[0], moe_layers[0] + len(moe_layers))
+        ):
+            kw["moe_pattern"] = "sandwich"
+            kw["dense_start_layers"] = moe_layers[0]
+            kw["dense_end_layers"] = len(layers) - 1 - moe_layers[-1]
     else:
         ffn = l0.get("ffn") or l0.get("mod_ffn")
         if ffn is not None and "wi" in ffn:
             kw["intermediate_size"] = ffn["wi"].shape[-1] // 2
+    if was_scanned:
+        kw["scan_layers"] = True
     return Config(**kw)

@@ -1,0 +1,219 @@
+"""scan_layers correctness: the lax.scan'd layer stack must be a pure
+re-layout — same weights, same outputs — of the unrolled stack, across MoE
+placement patterns, under remat, through the sharded train step, and on
+the KV-cache decode path. (VERDICT r1 weak #5 / next-round #3.)"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from luminaai_tpu.config import Config
+from luminaai_tpu.models.transformer import (
+    LuminaTransformer,
+    scan_segments,
+    stack_params_for_scan,
+    unstack_params_from_scan,
+)
+from luminaai_tpu.parallel.sharding import unbox
+
+
+def make_cfg(**kw) -> Config:
+    base = dict(
+        vocab_size=128,
+        hidden_size=32,
+        num_layers=6,
+        num_heads=2,
+        num_kv_heads=2,
+        seq_length=16,
+        batch_size=2,
+        use_moe=True,
+        num_experts=4,
+        moe_top_k=2,
+        moe_pattern="every_3rd",
+        use_flash_attention=False,
+        gradient_checkpointing=False,
+        precision="fp32",
+        dropout=0.0,
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+def test_scan_segments_cover_every_layer_once():
+    for pat, L in [
+        ("all", 5), ("none", 5), ("every_3rd", 8), ("every_4th", 9),
+        ("sandwich", 7),
+    ]:
+        cfg = make_cfg(moe_pattern=pat, num_layers=L)
+        covered = []
+        for start, offsets, count in scan_segments(cfg):
+            u = len(offsets)
+            for k in range(count):
+                for off in offsets:
+                    covered.append(start + k * u + off)
+        assert sorted(covered) == list(range(L)), (pat, covered)
+        # kinds must repeat exactly within each segment
+        for start, offsets, count in scan_segments(cfg):
+            u = len(offsets)
+            for off in offsets:
+                kinds = {
+                    cfg.is_moe_layer(start + k * u + off) for k in range(count)
+                }
+                assert len(kinds) == 1, (pat, start, off)
+
+
+@pytest.mark.parametrize(
+    "pattern,layers", [("every_3rd", 6), ("all", 4), ("sandwich", 6), ("none", 4)]
+)
+def test_scan_matches_unrolled_logits(pattern, layers):
+    cfg_plain = make_cfg(moe_pattern=pattern, num_layers=layers, scan_layers=False)
+    cfg_scan = make_cfg(moe_pattern=pattern, num_layers=layers, scan_layers=True)
+    model_p = LuminaTransformer(cfg_plain)
+    model_s = LuminaTransformer(cfg_scan)
+    ids = jnp.asarray(
+        np.random.RandomState(0).randint(1, 128, size=(2, 16)), jnp.int32
+    )
+    params = unbox(model_p.init(jax.random.key(0), ids)["params"])
+    stacked = stack_params_for_scan(cfg_scan, params)
+
+    logits_p, aux_p = model_p.apply({"params": params}, ids)
+    logits_s, aux_s = model_s.apply({"params": stacked}, ids)
+    np.testing.assert_allclose(
+        np.asarray(logits_p), np.asarray(logits_s), rtol=2e-5, atol=2e-5
+    )
+    np.testing.assert_allclose(
+        float(aux_p["aux_loss"]), float(aux_s["aux_loss"]), rtol=1e-5
+    )
+
+    # round-trip layout conversion is exact
+    back = unstack_params_from_scan(cfg_scan, stacked)
+    for path_leaf, orig_leaf in zip(
+        jax.tree.leaves(back), jax.tree.leaves(params)
+    ):
+        np.testing.assert_array_equal(np.asarray(path_leaf), np.asarray(orig_leaf))
+
+
+def test_scan_with_remat_matches_no_remat_loss():
+    cfg = make_cfg(scan_layers=True, gradient_checkpointing=True)
+    cfg_nr = make_cfg(scan_layers=True, gradient_checkpointing=False)
+    ids = jnp.asarray(
+        np.random.RandomState(1).randint(1, 128, size=(2, 16)), jnp.int32
+    )
+    model = LuminaTransformer(cfg)
+    params = unbox(model.init(jax.random.key(0), ids)["params"])
+
+    def loss(m, p):
+        logits, aux = m.apply({"params": p}, ids)
+        return logits.astype(jnp.float32).mean() + aux["aux_loss"]
+
+    l1 = loss(LuminaTransformer(cfg), params)
+    l2 = loss(LuminaTransformer(cfg_nr), params)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    g = jax.grad(lambda p: loss(LuminaTransformer(cfg), p))(params)
+    assert all(np.isfinite(x).all() for x in jax.tree.leaves(g))
+
+
+def test_scan_train_step_on_mesh():
+    from luminaai_tpu.parallel.mesh import build_mesh
+    from luminaai_tpu.parallel.sharding import init_sharded_state
+    from luminaai_tpu.parallel.train_step import make_train_step
+    from luminaai_tpu.training.optimizer import make_optimizer, make_schedule
+
+    cfg = make_cfg(
+        scan_layers=True,
+        moe_pattern="all",
+        num_layers=4,
+        num_experts=8,
+        batch_size=8,
+        fsdp_parallel_size=2,
+        expert_parallel_size=2,
+        tensor_parallel_size=2,
+    )
+    cfg.validate()
+    model = LuminaTransformer(cfg)
+    sched = make_schedule(cfg, 10)
+    tx = make_optimizer(cfg, 10, sched)
+    mesh = build_mesh(cfg)
+    state, sh = init_sharded_state(cfg, model, tx, mesh, jax.random.key(0))
+    step = make_train_step(cfg, model, sh, mesh, sched, tx)
+    ids = np.random.RandomState(0).randint(
+        1, cfg.vocab_size, size=(cfg.batch_size, cfg.seq_length)
+    )
+    state, metrics = step(state, {"input_ids": jnp.asarray(ids, jnp.int32)})
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_infer_config_from_scanned_params():
+    from luminaai_tpu.inference.generate import infer_config_from_params
+
+    cfg = make_cfg(scan_layers=True, moe_pattern="every_3rd", num_layers=6)
+    model = LuminaTransformer(cfg)
+    ids = jnp.ones((1, 16), jnp.int32)
+    params = unbox(model.init(jax.random.key(0), ids)["params"])
+    inferred = infer_config_from_params(params)
+    assert inferred.scan_layers is True
+    assert inferred.num_layers == 6
+    assert inferred.hidden_size == cfg.hidden_size
+    assert inferred.num_heads == cfg.num_heads
+    assert inferred.use_moe and inferred.num_experts == cfg.num_experts
+    assert inferred.moe_pattern == "every_3rd"
+    # inferred config must accept the scanned params as-is
+    logits, _ = LuminaTransformer(inferred).apply({"params": params}, ids)
+    assert logits.shape == (1, 16, cfg.vocab_size)
+
+
+def test_scan_metrics_match_unscanned_weighting():
+    """Diagnostics (e.g. expert load) must average identically per layer
+    whether or not the stack is scanned."""
+    cfg_p = make_cfg(moe_pattern="every_3rd", num_layers=8, scan_layers=False)
+    cfg_s = make_cfg(moe_pattern="every_3rd", num_layers=8, scan_layers=True)
+    ids = jnp.asarray(
+        np.random.RandomState(5).randint(1, 128, size=(2, 16)), jnp.int32
+    )
+    model_p = LuminaTransformer(cfg_p)
+    params = unbox(model_p.init(jax.random.key(0), ids)["params"])
+    stacked = stack_params_for_scan(cfg_s, params)
+    _, aux_p = model_p.apply({"params": params}, ids)
+    _, aux_s = LuminaTransformer(cfg_s).apply({"params": stacked}, ids)
+    assert set(aux_p.keys()) == set(aux_s.keys())
+    for k in aux_p:
+        np.testing.assert_allclose(
+            np.asarray(aux_p[k]), np.asarray(aux_s[k]), rtol=1e-5, atol=1e-6,
+            err_msg=k,
+        )
+
+
+def test_scan_decode_matches_full_forward():
+    """KV-cache decode under scan_layers agrees with the full forward."""
+    cfg = make_cfg(scan_layers=True, moe_pattern="none", num_layers=4)
+    model = LuminaTransformer(cfg)
+    rng = np.random.RandomState(3)
+    ids = jnp.asarray(rng.randint(1, 128, size=(1, 8)), jnp.int32)
+    params = unbox(model.init(jax.random.key(0), ids)["params"])
+
+    full_logits, _ = model.apply({"params": params}, ids)
+
+    caches = model.init_cache(1, 16)
+    positions = jnp.arange(8)[None, :]
+    logits_pre, caches, _ = model.apply(
+        {"params": params}, ids, positions=positions, kv_caches=caches,
+        cache_index=0, deterministic=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(full_logits), np.asarray(logits_pre), rtol=2e-5, atol=2e-5
+    )
+
+    # one decode step vs full forward on the extended sequence
+    nxt = jnp.asarray([[42]], jnp.int32)
+    logits_dec, caches, _ = model.apply(
+        {"params": params}, nxt, positions=jnp.asarray([[8]]),
+        kv_caches=caches, cache_index=jnp.asarray(8), deterministic=True,
+    )
+    ext = jnp.concatenate([ids, nxt], axis=1)
+    full_ext, _ = model.apply({"params": params}, ext)
+    np.testing.assert_allclose(
+        np.asarray(full_ext[:, -1]), np.asarray(logits_dec[:, -1]),
+        rtol=2e-5, atol=2e-5,
+    )
