@@ -1,0 +1,7 @@
+"""`python -m luminaai_tpu` → CLI (ref Main.py entry)."""
+
+import sys
+
+from luminaai_tpu.cli import main
+
+sys.exit(main())

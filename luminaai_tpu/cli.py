@@ -1,0 +1,469 @@
+"""Command-line entry point: train / resume / chat / benchmark / data /
+diagnose / presets.
+
+Covers the reference CLI surface (ref: Src/Main_Scripts/Main.py:1506 main()
+with config selection + adaptive-vs-standard training, :619 system
+diagnostics, :1404 chinchilla auto-epochs, :1126 signal handlers, plus
+Chat.py's interactive entry) as a proper argparse program:
+
+    python -m luminaai_tpu train --preset debug --synthetic --steps 30
+    python -m luminaai_tpu resume --output-dir runs/exp1
+    python -m luminaai_tpu chat --checkpoint runs/exp1/checkpoints
+    python -m luminaai_tpu benchmark
+    python -m luminaai_tpu data sample --out data/sample.jsonl
+    python -m luminaai_tpu diagnose
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import signal
+import sys
+from pathlib import Path
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# config assembly
+# ---------------------------------------------------------------------------
+def _apply_overrides(cfg, args) -> None:
+    """Map CLI flags onto Config fields (only when explicitly given)."""
+    for flag, field in [
+        ("lr", "learning_rate"),
+        ("batch_size", "batch_size"),
+        ("seq_length", "seq_length"),
+        ("steps", "max_steps"),
+        ("epochs", "num_epochs"),
+        ("precision", "precision"),
+        ("output_dir", "output_dir"),
+        ("experiment", "experiment_name"),
+        ("grad_accum", "gradient_accumulation_steps"),
+    ]:
+        val = getattr(args, flag, None)
+        if val is not None:
+            setattr(cfg, field, val)
+    if getattr(args, "no_moe", False):
+        cfg.use_moe = False
+    if getattr(args, "no_flash", False):
+        cfg.use_flash_attention = False
+
+
+def build_config(args):
+    from luminaai_tpu.config import ConfigManager, ConfigPresets
+
+    if getattr(args, "config", None):
+        from luminaai_tpu.config import Config
+
+        cfg = Config.load(args.config)
+    else:
+        cfg = ConfigPresets.get(args.preset)
+    _apply_overrides(cfg, args)
+    if getattr(args, "auto_hardware", False):
+        cfg = ConfigManager.optimize_for_hardware(cfg)
+    cfg.validate()
+    return cfg
+
+
+# ---------------------------------------------------------------------------
+# data wiring
+# ---------------------------------------------------------------------------
+def _synthetic_batches(cfg, n_batches: int = 200, seed: int = 0):
+    """Learnable repeating-pattern batches (smoke training, ref debug
+    runs on synthetic data)."""
+
+    def gen() -> Iterator[Dict[str, np.ndarray]]:
+        rng = np.random.RandomState(seed)
+        period = min(64, cfg.vocab_size - 2)
+        for _ in range(n_batches):
+            starts = rng.randint(0, 32, size=(cfg.batch_size, 1))
+            seq = (starts + np.arange(cfg.seq_length)) % period + 1
+            yield {"input_ids": seq.astype(np.int32)}
+
+    return gen
+
+
+def make_data(cfg, args):
+    """Returns (train_fn, eval_fn, dataset_tokens|None)."""
+    from luminaai_tpu.data.dataset import (
+        ConversationDataset,
+        PackedDataset,
+        PrefetchLoader,
+        build_text_cache,
+        conversation_batches,
+    )
+    from luminaai_tpu.data.tokenizer import ConversationTokenizer
+
+    if getattr(args, "synthetic", False) or not getattr(args, "data", None):
+        if not getattr(args, "synthetic", False):
+            logger.warning("no --data given; training on synthetic data")
+        return _synthetic_batches(cfg), None, None
+
+    path = args.data
+    tokenizer = ConversationTokenizer(
+        assistant_loss_weight=cfg.assistant_loss_weight
+    )
+    if getattr(args, "packed", False):
+        cache = build_text_cache(
+            path, str(Path(cfg.output_dir) / "cache" / Path(path).stem),
+            tokenizer,
+        )
+        ds = PackedDataset(
+            cache, cfg.batch_size, cfg.seq_length,
+            pad_id=tokenizer.pad_token_id, eos_id=tokenizer.eos_token_id,
+            shuffle_seed=cfg.seed,
+        )
+        return PrefetchLoader(lambda: iter(ds)), None, cache.n_tokens
+
+    ds = ConversationDataset(path, tokenizer, cfg)
+    tokens = None
+    if not ds.streaming:
+        tokens = sum(int(s["loss_mask"].size) for s in ds.samples)
+
+    def train_fn():
+        return conversation_batches(ds, cfg.batch_size, seed=cfg.seed)
+
+    eval_fn = None
+    if getattr(args, "eval_data", None):
+        eval_ds = ConversationDataset(args.eval_data, tokenizer, cfg, split="eval")
+
+        def eval_fn():
+            return conversation_batches(eval_ds, cfg.batch_size, seed=0)
+
+    return PrefetchLoader(train_fn), eval_fn, tokens
+
+
+# ---------------------------------------------------------------------------
+# subcommands
+# ---------------------------------------------------------------------------
+def cmd_train(args) -> int:
+    from luminaai_tpu.training.orchestrator import AdaptiveTrainingOrchestrator
+    from luminaai_tpu.training.scaler import ChinchillaScaler
+    from luminaai_tpu.training.trainer import Trainer
+    from luminaai_tpu.utils.environment import format_diagnostics
+
+    if not args.quiet:
+        print(format_diagnostics())
+
+    cfg = build_config(args)
+    if args.resume:
+        cfg.auto_resume = True
+    train_fn, eval_fn, dataset_tokens = make_data(cfg, args)
+
+    if args.auto_epochs and dataset_tokens:
+        # Chinchilla budget → step count (ref Main.py:1404
+        # auto_adjust_epochs_chinchilla). An explicit --steps wins: the
+        # budget is advice, not an override of the operator.
+        plan = ChinchillaScaler(cfg).plan(dataset_tokens)
+        if args.steps is None:
+            cfg.max_steps = plan.recommended_steps
+        print(
+            f"chinchilla auto-budget: recommended_steps="
+            f"{plan.recommended_steps} (dataset {dataset_tokens:,} tokens, "
+            f"applied={'yes' if args.steps is None else 'no, --steps set'})"
+        )
+
+    trainer = Trainer(cfg, train_data=train_fn, eval_data=eval_fn)
+    _install_signal_handlers(trainer)
+
+    if args.adaptive:
+        orchestrator = AdaptiveTrainingOrchestrator(trainer)
+        summary = orchestrator.run()
+    else:
+        summary = trainer.train()
+    trainer.close()
+
+    out = Path(cfg.output_dir) / "training_summary.json"
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(_jsonable(summary), indent=2))
+    final = summary.get("final_metrics", {})
+    print(
+        f"training done: steps={summary.get('final_step')} "
+        f"final_loss={final.get('loss', float('nan')):.4f} "
+        f"summary={out}"
+    )
+    return 0
+
+
+def cmd_chat(args) -> int:
+    from luminaai_tpu.inference.chat import ChatInterface
+
+    chat = ChatInterface(checkpoint_dir=args.checkpoint)
+    # Generation defaults live on the engine's config (ref Chat.py mode
+    # presets); CLI flags override them for the session.
+    chat.engine.config.temperature = args.temperature
+    chat.engine.config.top_p = args.top_p
+    chat.engine.config.max_new_tokens = args.max_new_tokens
+
+    if args.secure:
+        # Authenticated, rate-limited, input-validated path (ref
+        # security/rate_limiter.py:107 SecureConversationalChat).
+        from luminaai_tpu.security import SecureChatSession
+
+        secure = SecureChatSession(chat.respond)
+        user = args.user or "operator"
+        password = args.password
+        if password is None:
+            import getpass
+
+            password = getpass.getpass(f"password for {user}: ")
+        if user not in secure.security.users:
+            if not secure.create_user(user, password):
+                print("could not create user (weak password?)", file=sys.stderr)
+                return 2
+        token = secure.authenticate(user, password)
+        if token is None:
+            print("authentication failed", file=sys.stderr)
+            return 2
+        if args.prompt:
+            out = secure.secure_respond(args.prompt, token)
+            if not out["ok"]:
+                print(f"rejected: {out['error']}", file=sys.stderr)
+                return 1
+            print(out["reply"])
+            return 0
+        print("secure chat — 'quit' to exit")
+        while True:  # pragma: no cover - interactive
+            try:
+                line = input("> ")
+            except (EOFError, KeyboardInterrupt):
+                break
+            if line.strip().lower() in ("quit", "exit"):
+                break
+            out = secure.secure_respond(line, token)
+            print(out["reply"] if out["ok"] else f"[{out['error']}]")
+        return 0
+
+    if args.prompt:
+        reply, stats = chat.respond(args.prompt)
+        print(reply)
+        if args.verbose:
+            print(json.dumps(stats, indent=2), file=sys.stderr)
+        return 0
+    chat.run()
+    return 0
+
+
+def cmd_benchmark(args) -> int:
+    """Run the repo bench harness (one JSON line, same as the driver)."""
+    import subprocess
+
+    bench = Path(__file__).resolve().parent.parent / "bench.py"
+    if args.ops:
+        bench = Path(__file__).resolve().parent.parent / "bench_ops.py"
+    if not bench.exists():
+        print(f"benchmark harness not found: {bench}", file=sys.stderr)
+        return 2
+    return subprocess.call([sys.executable, str(bench)])
+
+
+def cmd_data(args) -> int:
+    from luminaai_tpu.data.processing import (
+        create_sample_data,
+        process_oasst_data,
+        validate_data_comprehensive,
+    )
+
+    if args.action == "sample":
+        n = create_sample_data(args.out, num_conversations=args.count)
+        print(f"wrote {n} sample conversations to {args.out}")
+    elif args.action == "oasst":
+        n = process_oasst_data(args.inp, args.out)
+        print(f"converted {n} conversations -> {args.out}")
+    elif args.action == "validate":
+        from luminaai_tpu.data.tokenizer import ConversationTokenizer
+
+        report = validate_data_comprehensive(
+            args.inp, ConversationTokenizer()
+        )
+        print(json.dumps(_jsonable(report), indent=2))
+    return 0
+
+
+def cmd_diagnose(args) -> int:
+    from luminaai_tpu.utils.environment import (
+        check_config_fits,
+        format_diagnostics,
+        recommend_preset,
+    )
+
+    print(format_diagnostics())
+    try:
+        print(f"recommended preset for this fleet: {recommend_preset()}")
+        if args.preset:
+            from luminaai_tpu.config import ConfigPresets
+
+            fit = check_config_fits(ConfigPresets.get(args.preset))
+            print(f"{args.preset}: {json.dumps(fit, indent=2)}")
+    except Exception as e:
+        print(f"recommendation unavailable: {e}")
+    return 0
+
+
+def cmd_presets(args) -> int:
+    from luminaai_tpu.config import ConfigPresets
+
+    info = ConfigPresets.get_preset_info()
+    if args.json:
+        print(json.dumps(info, indent=2))
+        return 0
+    header = (
+        f"{'preset':<16}{'hidden':>8}{'layers':>8}{'params':>12}"
+        f"{'active':>12}{'experts':>8}{'seq':>8}"
+    )
+    print(header)
+    print("-" * len(header))
+    for name, d in info.items():
+        print(
+            f"{name:<16}{d['hidden_size']:>8}{d['num_layers']:>8}"
+            f"{d['total_params'] / 1e6:>10.0f}M{d['active_params'] / 1e6:>10.0f}M"
+            f"{d['num_experts']:>8}{d['seq_length']:>8}"
+        )
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# plumbing
+# ---------------------------------------------------------------------------
+def _jsonable(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        return {k: _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if isinstance(obj, (np.floating, np.integer)):
+        return obj.item()
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, float) and not np.isfinite(obj):
+        return str(obj)
+    return obj
+
+
+def _install_signal_handlers(trainer) -> None:
+    """SIGINT/SIGTERM → emergency checkpoint, then exit (ref Main.py:1126
+    setup_signal_handlers)."""
+
+    def handler(sig, frame):  # pragma: no cover - signal-driven
+        print(f"\nsignal {sig}: saving emergency checkpoint...")
+        try:
+            trainer.save_checkpoint(force=True)
+            trainer.close()
+            print("state saved; exiting")
+        except Exception as e:
+            print(f"emergency save failed: {e}")
+        sys.exit(128 + sig)
+
+    try:
+        signal.signal(signal.SIGINT, handler)
+        signal.signal(signal.SIGTERM, handler)
+    except ValueError:  # pragma: no cover - non-main thread (tests)
+        pass
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="luminaai_tpu",
+        description="TPU-native adaptive training framework",
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    def add_config_flags(sp):
+        sp.add_argument("--preset", default="debug")
+        sp.add_argument("--config", help="yaml/json config file")
+        sp.add_argument("--lr", type=float)
+        sp.add_argument("--batch-size", dest="batch_size", type=int)
+        sp.add_argument("--seq-length", dest="seq_length", type=int)
+        sp.add_argument("--steps", type=int, help="max optimizer steps")
+        sp.add_argument("--epochs", type=int)
+        sp.add_argument("--grad-accum", dest="grad_accum", type=int)
+        sp.add_argument("--precision", choices=["fp32", "bf16", "mixed_bf16", "auto"])
+        sp.add_argument("--output-dir", dest="output_dir")
+        sp.add_argument("--experiment")
+        sp.add_argument("--no-moe", action="store_true")
+        sp.add_argument("--no-flash", action="store_true")
+        sp.add_argument(
+            "--auto-hardware", action="store_true",
+            help="optimize parallelism for detected devices",
+        )
+
+    t = sub.add_parser("train", help="train a model")
+    add_config_flags(t)
+    t.add_argument("--data", help="jsonl conversations (or text with --packed)")
+    t.add_argument("--eval-data", dest="eval_data")
+    t.add_argument("--packed", action="store_true",
+                   help="treat --data as base-training text jsonl")
+    t.add_argument("--synthetic", action="store_true",
+                   help="train on synthetic pattern data (smoke test)")
+    t.add_argument("--adaptive", action=argparse.BooleanOptionalAction,
+                   default=True, help="run under the adaptive orchestrator")
+    t.add_argument("--auto-epochs", action="store_true",
+                   help="chinchilla-style step budget from dataset size")
+    t.add_argument("--resume", action="store_true")
+    t.add_argument("--quiet", action="store_true")
+    t.set_defaults(fn=cmd_train)
+
+    r = sub.add_parser("resume", help="resume training from output dir")
+    add_config_flags(r)
+    r.add_argument("--data")
+    r.add_argument("--eval-data", dest="eval_data")
+    r.add_argument("--packed", action="store_true")
+    r.add_argument("--synthetic", action="store_true")
+    r.add_argument("--adaptive", action=argparse.BooleanOptionalAction,
+                   default=True)
+    r.add_argument("--auto-epochs", action="store_true")
+    r.add_argument("--quiet", action="store_true")
+    r.set_defaults(fn=cmd_train, resume=True)
+    t.set_defaults(resume=False)
+
+    c = sub.add_parser("chat", help="interactive chat with a checkpoint")
+    c.add_argument("--checkpoint", help="checkpoint dir (auto-discovers latest)")
+    c.add_argument("--temperature", type=float, default=0.8)
+    c.add_argument("--top-p", dest="top_p", type=float, default=0.9)
+    c.add_argument("--max-new-tokens", dest="max_new_tokens", type=int,
+                   default=256)
+    c.add_argument("--prompt", help="one-shot prompt (non-interactive)")
+    c.add_argument("--verbose", action="store_true")
+    c.add_argument("--secure", action="store_true",
+                   help="require auth; rate-limit and validate inputs")
+    c.add_argument("--user")
+    c.add_argument("--password")
+    c.set_defaults(fn=cmd_chat)
+
+    b = sub.add_parser("benchmark", help="run the bench harness")
+    b.add_argument("--ops", action="store_true",
+                   help="op-level microbenchmarks instead of train throughput")
+    b.set_defaults(fn=cmd_benchmark)
+
+    d = sub.add_parser("data", help="dataset utilities")
+    d.add_argument("action", choices=["sample", "oasst", "validate"])
+    d.add_argument("--in", dest="inp")
+    d.add_argument("--out")
+    d.add_argument("--count", type=int, default=100)
+    d.set_defaults(fn=cmd_data)
+
+    g = sub.add_parser("diagnose", help="system diagnostics")
+    g.add_argument("--preset", help="also check whether PRESET fits")
+    g.set_defaults(fn=cmd_diagnose)
+
+    s = sub.add_parser("presets", help="list model presets")
+    s.add_argument("--json", action="store_true")
+    s.set_defaults(fn=cmd_presets)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

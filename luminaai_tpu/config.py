@@ -704,11 +704,16 @@ class ConfigManager:
 
     @staticmethod
     def optimize_for_hardware(config: Config, n_devices: Optional[int] = None) -> Config:
-        """Pick a mesh layout for the available devices
-        (ref config_manager.py:1921 optimize_for_hardware)."""
-        import jax
+        """Pick a mesh layout for the *detected* devices
+        (ref config_manager.py:1921 optimize_for_hardware). Uses real device
+        introspection (utils.environment): per-chip HBM decides how much
+        model sharding (fsdp/tp) is needed; leftover devices become data
+        parallelism."""
+        from luminaai_tpu.utils.environment import get_device_info
 
-        n = n_devices or jax.device_count()
+        dev = get_device_info()
+        n = n_devices or dev["device_count"]
+        hbm_gb = dev.get("memory_per_device_gb") or 16.0
         updates: Dict[str, Any] = {}
         # Shard experts first (cheap all-to-all on ICI), then FSDP the rest.
         ep = 1
@@ -716,12 +721,22 @@ class ConfigManager:
             ep = math.gcd(config.num_experts, n)
         remaining = n // ep
         updates["expert_parallel_size"] = ep
-        updates["fsdp_parallel_size"] = remaining
         updates["data_parallel_size"] = 1
-        params_gb = config.estimate_parameters() * 2 / 1e9
-        if params_gb / max(1, remaining) > 16 and remaining >= 2:
-            updates["tensor_parallel_size"] = 2
-            updates["fsdp_parallel_size"] = remaining // 2
+        # State per chip: bf16/fp32 params + Adam moments ≈ 12 bytes/param,
+        # divided across the model-sharding axes. Grow tp while one chip
+        # can't hold its shard (norm+embed replicas bound fsdp's reach).
+        state_gb = config.estimate_parameters() * 12 / 1e9
+        tp = 1
+        while (
+            state_gb / max(1, remaining) > hbm_gb * 0.5
+            and remaining >= 2
+            and tp < 8
+            and config.num_heads % (tp * 2) == 0
+        ):
+            tp *= 2
+            remaining //= 2
+        updates["tensor_parallel_size"] = tp
+        updates["fsdp_parallel_size"] = remaining
         return dataclasses.replace(config, **updates)
 
     @staticmethod

@@ -98,8 +98,22 @@ def load_model_for_inference(
             step = mngr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {ckpt}")
+        # Restore onto the CURRENT devices: training checkpoints carry the
+        # training mesh's shardings, which won't exist at inference time
+        # (e.g. 8-device train mesh → 1 chip serving). Build an abstract
+        # target from the saved array metadata, placed on one local device.
+        sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        meta_tree = ocp.PyTreeCheckpointer().metadata(
+            str(ckpt / str(step) / "state")
+        ).item_metadata
+        meta_tree = getattr(meta_tree, "tree", meta_tree)
+        abstract = jax.tree.map(
+            lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype, sharding=sharding),
+            meta_tree,
+        )
         restored = mngr.restore(
-            step, args=ocp.args.Composite(state=ocp.args.StandardRestore())
+            step,
+            args=ocp.args.Composite(state=ocp.args.StandardRestore(abstract)),
         )["state"]
         params = restored["params"]
         if config is None:

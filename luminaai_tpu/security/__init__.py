@@ -1,0 +1,22 @@
+"""Security subsystem: auth, sessions, rate limiting, input validation
+(ref: Src/Main_Scripts/security/)."""
+
+from luminaai_tpu.security.auth import SecurityManager, Session, User
+from luminaai_tpu.security.input_validator import (
+    InputValidator,
+    ValidationResult,
+)
+from luminaai_tpu.security.rate_limiter import (
+    RateLimiter,
+    SecureChatSession,
+)
+
+__all__ = [
+    "SecurityManager",
+    "Session",
+    "User",
+    "InputValidator",
+    "ValidationResult",
+    "RateLimiter",
+    "SecureChatSession",
+]

@@ -1,0 +1,162 @@
+"""Request rate limiting + the secured chat wrapper.
+
+Covers the reference RateLimiter and SecureConversationalChat (ref:
+Src/Main_Scripts/security/rate_limiter.py:8,107 — sliding-window limits
+per identifier/action with remaining/reset introspection; a chat facade
+that requires authentication, validates every input, rate-limits message
+traffic, and audit-logs the session).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from luminaai_tpu.security.auth import SecurityManager
+from luminaai_tpu.security.input_validator import InputValidator
+
+logger = logging.getLogger(__name__)
+
+# action -> (max requests, window seconds) (ref rate_limiter.py:11)
+DEFAULT_LIMITS: Dict[str, Tuple[int, float]] = {
+    "chat_message": (30, 60.0),
+    "login": (10, 60.0),
+    "generate": (20, 60.0),
+}
+
+
+class RateLimiter:
+    """Sliding-window limiter keyed by (identifier, action) (ref :8)."""
+
+    def __init__(
+        self, limits: Optional[Dict[str, Tuple[int, float]]] = None
+    ):
+        self.limits = dict(DEFAULT_LIMITS)
+        if limits:
+            self.limits.update(limits)
+        self._events: Dict[Tuple[str, str], List[float]] = {}
+
+    def _window(self, key: Tuple[str, str], window: float, now: float):
+        events = [t for t in self._events.get(key, []) if now - t < window]
+        self._events[key] = events
+        return events
+
+    def is_allowed(
+        self,
+        identifier: str,
+        action: str,
+        custom_limit: Optional[Tuple[int, float]] = None,
+    ) -> bool:
+        """(ref :25)"""
+        limit, window = custom_limit or self.limits.get(action, (60, 60.0))
+        now = time.time()
+        key = (identifier, action)
+        events = self._window(key, window, now)
+        if len(events) >= limit:
+            return False
+        events.append(now)
+        return True
+
+    def get_remaining_requests(self, identifier: str, action: str) -> int:
+        """(ref :47)"""
+        limit, window = self.limits.get(action, (60, 60.0))
+        events = self._window((identifier, action), window, time.time())
+        return max(0, limit - len(events))
+
+    def get_reset_time(self, identifier: str, action: str) -> Optional[float]:
+        """Seconds until a blocked identifier can act again (ref :62)."""
+        limit, window = self.limits.get(action, (60, 60.0))
+        events = self._window((identifier, action), window, time.time())
+        if len(events) < limit:
+            return None
+        return max(0.0, events[0] + window - time.time())
+
+    def cleanup_old_buckets(self) -> int:
+        """Drop empty windows; returns surviving bucket count (ref :75)."""
+        now = time.time()
+        for key in list(self._events):
+            action = key[1]
+            _, window = self.limits.get(action, (60, 60.0))
+            if not self._window(key, window, now):
+                del self._events[key]
+        return len(self._events)
+
+
+class SecureChatSession:
+    """Authenticated, validated, rate-limited chat facade (ref :107
+    SecureConversationalChat).
+
+    Wraps anything exposing `respond(text) -> (reply, stats)` — the
+    ChatInterface, or a bare GenerationEngine adapter. All security
+    decisions happen here so the inference stack stays policy-free.
+    """
+
+    def __init__(
+        self,
+        respond_fn: Callable[[str], Tuple[str, Dict[str, Any]]],
+        security: Optional[SecurityManager] = None,
+        rate_limiter: Optional[RateLimiter] = None,
+        validator: Optional[InputValidator] = None,
+    ):
+        self.respond_fn = respond_fn
+        self.security = security or SecurityManager()
+        self.rate_limiter = rate_limiter or RateLimiter()
+        self.validator = validator or InputValidator()
+        self.stats = {"messages": 0, "rejected": 0}
+
+    # -- account/session passthrough (ref :123,224,228) --------------------
+    def create_user(self, username: str, password: str, permissions=None):
+        return self.security.create_user(username, password, permissions)
+
+    def authenticate(
+        self, username: str, password: str, client_ip: str = ""
+    ) -> Optional[str]:
+        if not self.rate_limiter.is_allowed(client_ip or username, "login"):
+            return None
+        return self.security.authenticate(username, password, client_ip)
+
+    def logout(self, token: str) -> bool:
+        return self.security.logout(token)
+
+    # -- the secured message path (ref :141) -------------------------------
+    def secure_respond(
+        self, user_input: str, session_token: str
+    ) -> Dict[str, Any]:
+        """Returns {ok, reply?, error?, stats?}. Order: session → permission
+        → rate limit → validation → generate."""
+        session = self.security.validate_session(session_token)
+        if session is None:
+            self.stats["rejected"] += 1
+            return {"ok": False, "error": "invalid or expired session"}
+        if not self.security.check_permission(session, "chat"):
+            self.stats["rejected"] += 1
+            return {"ok": False, "error": "permission denied"}
+        user = session["username"]
+        if not self.rate_limiter.is_allowed(user, "chat_message"):
+            self.stats["rejected"] += 1
+            reset = self.rate_limiter.get_reset_time(user, "chat_message")
+            return {
+                "ok": False,
+                "error": "rate limit exceeded",
+                "retry_after_sec": round(reset or 0.0, 1),
+            }
+        check = self.validator.validate_user_input(user_input)
+        if not check.valid:
+            self.stats["rejected"] += 1
+            return {"ok": False, "error": "; ".join(check.errors)}
+        reply, gen_stats = self.respond_fn(check.sanitized)
+        self.stats["messages"] += 1
+        return {
+            "ok": True,
+            "reply": reply,
+            "stats": gen_stats,
+            "warnings": check.warnings,
+        }
+
+    def get_security_status(self) -> Dict[str, Any]:
+        """(ref :232)"""
+        return {
+            **self.security.get_security_status(),
+            "session_stats": dict(self.stats),
+        }

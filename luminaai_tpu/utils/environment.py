@@ -1,0 +1,195 @@
+"""Environment detection: devices, topology, memory, recommended config.
+
+Covers the reference environment module (ref: Src/Main_Scripts/utils/
+environment.py — get_system_info, GPU/accelerator introspection, memory
+estimates, recommended-config selection), re-targeted at JAX/TPU: the
+accelerator story is `jax.devices()` + device memory_stats, topology is the
+process/host layout JAX exposes, and the recommendation maps model memory
+needs onto a mesh (fsdp/tp/ep) instead of CUDA settings.
+"""
+
+from __future__ import annotations
+
+import os
+import platform
+import sys
+from typing import Any, Dict, List, Optional
+
+# Per-chip HBM for known TPU generations (GiB). Used when memory_stats()
+# is unavailable (e.g. CPU hosts, some plugin backends).
+_TPU_HBM_GB = {
+    "v4": 32.0,
+    "v5 lite": 16.0,
+    "v5e": 16.0,
+    "v5p": 95.0,
+    "v6 lite": 32.0,
+    "v6e": 32.0,
+}
+
+
+def get_system_info() -> Dict[str, Any]:
+    """Host-side software/hardware summary (ref environment.py
+    get_system_info)."""
+    info: Dict[str, Any] = {
+        "python_version": sys.version.split()[0],
+        "platform": platform.platform(),
+        "cpu_count": os.cpu_count(),
+    }
+    try:
+        import jax
+
+        info["jax_version"] = jax.__version__
+    except Exception:  # pragma: no cover - jax is a hard dep in practice
+        info["jax_version"] = None
+    try:
+        import flax
+
+        info["flax_version"] = flax.__version__
+    except Exception:  # pragma: no cover
+        info["flax_version"] = None
+    try:
+        page = os.sysconf("SC_PAGE_SIZE")
+        info["host_memory_gb"] = round(
+            page * os.sysconf("SC_PHYS_PAGES") / 1e9, 2
+        )
+        info["host_memory_available_gb"] = round(
+            page * os.sysconf("SC_AVPHYS_PAGES") / 1e9, 2
+        )
+    except (ValueError, OSError):  # pragma: no cover - non-POSIX
+        pass
+    return info
+
+
+def _device_memory_gb(device) -> Optional[float]:
+    """Best-effort per-device memory: live stats, else known HBM table."""
+    try:
+        stats = device.memory_stats()
+        if stats and "bytes_limit" in stats:
+            return round(stats["bytes_limit"] / 1e9, 2)
+    except Exception:
+        pass
+    kind = getattr(device, "device_kind", "").lower()
+    for key, gb in _TPU_HBM_GB.items():
+        if key in kind:
+            return gb
+    return None
+
+
+def get_device_info() -> Dict[str, Any]:
+    """Accelerator summary (ref environment.py CUDA introspection block)."""
+    import jax
+
+    devices = jax.devices()
+    d0 = devices[0]
+    info: Dict[str, Any] = {
+        "platform": d0.platform,
+        "device_count": len(devices),
+        "local_device_count": jax.local_device_count(),
+        "device_kind": getattr(d0, "device_kind", "unknown"),
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "memory_per_device_gb": _device_memory_gb(d0),
+    }
+    coords = getattr(d0, "coords", None)
+    if coords is not None:
+        info["topology_coords_present"] = True
+        # Bounding box of chip coordinates ~ slice shape.
+        all_coords = [d.coords for d in devices if hasattr(d, "coords")]
+        if all_coords:
+            dims = len(all_coords[0])
+            info["topology_shape"] = tuple(
+                max(c[i] for c in all_coords) + 1 for i in range(dims)
+            )
+    return info
+
+
+def get_topology() -> Dict[str, Any]:
+    """Process/host layout for multi-host planning (ref topology probing)."""
+    import jax
+
+    return {
+        "process_count": jax.process_count(),
+        "process_index": jax.process_index(),
+        "devices_per_process": jax.local_device_count(),
+        "global_devices": jax.device_count(),
+    }
+
+
+def estimate_training_memory_gb(config) -> Dict[str, float]:
+    """Per-chip HBM need for a config under its parallelism settings."""
+    est = config.memory_estimate_gb()
+    model_shards = max(
+        1,
+        config.fsdp_parallel_size
+        * max(1, config.tensor_parallel_size)
+        * max(1, config.expert_parallel_size),
+    )
+    per_chip = {
+        "params_gb": est["parameters_gb"] / model_shards,
+        "optimizer_gb": est["optimizer_gb"] / model_shards,
+        "activations_gb": est["activations_gb"],
+        "total_gb": (est["parameters_gb"] + est["optimizer_gb"]) / model_shards
+        + est["activations_gb"],
+    }
+    return {k: round(v, 3) for k, v in per_chip.items()}
+
+
+def check_config_fits(config, n_devices: Optional[int] = None) -> Dict[str, Any]:
+    """Does this config fit the detected hardware? (ref recommended-config
+    validation). Returns {fits, per_chip_gb, available_gb, detail}."""
+    dev = get_device_info()
+    hbm = dev.get("memory_per_device_gb") or 16.0
+    need = estimate_training_memory_gb(config)
+    fits = need["total_gb"] <= hbm * 0.92  # leave headroom for XLA scratch
+    return {
+        "fits": fits,
+        "per_chip_gb": need["total_gb"],
+        "available_gb": hbm,
+        "platform": dev["platform"],
+        "device_count": n_devices or dev["device_count"],
+        "detail": need,
+    }
+
+
+def recommend_preset(n_devices: Optional[int] = None) -> str:
+    """Pick the largest preset that fits the detected fleet (ref
+    environment.py recommended-config logic)."""
+    from luminaai_tpu.config import ConfigPresets
+
+    dev = get_device_info()
+    n = n_devices or dev["device_count"]
+    hbm = dev.get("memory_per_device_gb") or 16.0
+    budget_gb = n * hbm * 0.92
+    best = "debug"
+    for name in ConfigPresets.available():
+        cfg = ConfigPresets.get(name)
+        total = cfg.memory_estimate_gb()
+        need = total["parameters_gb"] + total["optimizer_gb"]
+        if need <= budget_gb and cfg.estimate_parameters() > (
+            ConfigPresets.get(best).estimate_parameters()
+        ):
+            best = name
+    return best
+
+
+def format_diagnostics() -> str:
+    """Human-readable diagnostics block (ref Main.py:619
+    print_system_diagnostics)."""
+    lines: List[str] = ["=" * 64, "SYSTEM DIAGNOSTICS", "=" * 64]
+    sysinfo = get_system_info()
+    lines.append("[host]")
+    for k, v in sysinfo.items():
+        lines.append(f"  {k}: {v}")
+    try:
+        dev = get_device_info()
+        lines.append("[accelerator]")
+        for k, v in dev.items():
+            lines.append(f"  {k}: {v}")
+        topo = get_topology()
+        lines.append("[topology]")
+        for k, v in topo.items():
+            lines.append(f"  {k}: {v}")
+    except Exception as e:  # backend can be unavailable (tunnel flake)
+        lines.append(f"[accelerator] unavailable: {e}")
+    lines.append("=" * 64)
+    return "\n".join(lines)

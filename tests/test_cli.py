@@ -1,0 +1,122 @@
+"""CLI end-to-end coverage (ref Main.py surface): train/resume round-trip,
+data utilities, diagnostics, presets, config plumbing."""
+
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from luminaai_tpu.cli import build_parser, main
+
+
+def run_cli(argv):
+    return main(argv)
+
+
+def test_parser_rejects_unknown_command():
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["frobnicate"])
+
+
+def test_presets_listing(capsys):
+    assert run_cli(["presets"]) == 0
+    out = capsys.readouterr().out
+    assert "debug" in out and "b300" in out
+
+    assert run_cli(["presets", "--json"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["b7"]["num_layers"] == 32
+
+
+def test_diagnose_runs(capsys):
+    assert run_cli(["diagnose"]) == 0
+    out = capsys.readouterr().out
+    assert "SYSTEM DIAGNOSTICS" in out
+    assert "device_count: 8" in out  # conftest's virtual CPU mesh
+
+
+def test_data_sample_writes_conversations(tmp_path):
+    sample = tmp_path / "sample.jsonl"
+    assert run_cli(["data", "sample", "--out", str(sample), "--count", "7"]) == 0
+    lines = sample.read_text().strip().splitlines()
+    assert len(lines) == 7
+    assert all("messages" in json.loads(l) for l in lines)
+
+
+def test_data_validate_reports_token_stats(tmp_path, capsys):
+    sample = tmp_path / "s.jsonl"
+    run_cli(["data", "sample", "--out", str(sample), "--count", "5"])
+    capsys.readouterr()
+    assert run_cli(["data", "validate", "--in", str(sample)]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["token_stats"]["max"] > 0
+
+
+def test_train_resume_chat_roundtrip(tmp_path, capsys):
+    """The flagship CLI flow: short synthetic train, resume continues from
+    the checkpoint, chat loads it on a different device layout."""
+    out_dir = str(tmp_path / "run")
+    base = [
+        "train", "--preset", "debug", "--synthetic", "--precision", "fp32",
+        "--no-flash", "--lr", "1e-3", "--batch-size", "8",
+        "--output-dir", out_dir, "--quiet", "--no-adaptive",
+    ]
+    assert run_cli(base + ["--steps", "6"]) == 0
+    summary = json.loads((Path(out_dir) / "training_summary.json").read_text())
+    assert summary["final_step"] == 6
+
+    assert run_cli([
+        "resume", "--preset", "debug", "--synthetic", "--precision", "fp32",
+        "--no-flash", "--lr", "1e-3", "--batch-size", "8",
+        "--output-dir", out_dir, "--quiet", "--no-adaptive", "--steps", "10",
+    ]) == 0
+    summary = json.loads((Path(out_dir) / "training_summary.json").read_text())
+    assert summary["final_step"] == 10
+
+    capsys.readouterr()
+    assert run_cli([
+        "chat", "--checkpoint", f"{out_dir}/checkpoints",
+        "--prompt", "hello", "--max-new-tokens", "4",
+    ]) == 0
+    assert capsys.readouterr().out  # produced some text
+
+
+def test_train_auto_epochs_with_packed_data(tmp_path, capsys):
+    """--packed --auto-epochs: text jsonl → token cache → chinchilla step
+    budget."""
+    docs = tmp_path / "docs.jsonl"
+    rng = np.random.RandomState(0)
+    with docs.open("w") as f:
+        for i in range(30):
+            words = " ".join(
+                "abcdefgh"[rng.randint(0, 8)] * rng.randint(1, 5)
+                for _ in range(rng.randint(20, 60))
+            )
+            f.write(json.dumps({"text": words}) + "\n")
+    out_dir = str(tmp_path / "run2")
+    assert run_cli([
+        "train", "--preset", "debug", "--data", str(docs), "--packed",
+        "--auto-epochs", "--precision", "fp32", "--no-flash",
+        "--batch-size", "8", "--steps", "4", "--output-dir", out_dir,
+        "--quiet", "--no-adaptive",
+    ]) == 0
+    out = capsys.readouterr().out
+    assert "chinchilla auto-budget" in out
+    assert (Path(out_dir) / "training_summary.json").exists()
+
+
+def test_config_file_roundtrip(tmp_path):
+    from luminaai_tpu.config import ConfigPresets
+
+    cfg = ConfigPresets.debug()
+    cfg.learning_rate = 3.21e-4
+    path = tmp_path / "cfg.json"
+    cfg.save(str(path))
+    from luminaai_tpu.cli import build_config
+
+    args = build_parser().parse_args(
+        ["train", "--config", str(path), "--synthetic", "--quiet"]
+    )
+    loaded = build_config(args)
+    assert abs(loaded.learning_rate - 3.21e-4) < 1e-12
