@@ -117,21 +117,26 @@ def _child_main(name: str) -> None:
     )
     batch = {"input_ids": jnp.asarray(ids, jnp.int32)}
 
+    # Timing boundaries force a host transfer of the step's loss: under the
+    # tunneled TPU backend block_until_ready alone can return before device
+    # execution finishes (r2: it reported 2ms "steps" on a 46-TFLOP program),
+    # and a float() round-trip cannot lie about completion.
+
     # First step = compile + execute; measured separately.
     t0 = time.perf_counter()
     state, metrics = step(state, batch)
-    jax.block_until_ready(metrics["loss"])
+    float(metrics["loss"])
     compile_s = time.perf_counter() - t0
 
     # Warmup one more executed step so caches/donation settle.
     state, metrics = step(state, batch)
-    jax.block_until_ready(metrics["loss"])
+    float(metrics["loss"])
 
     steps = 20 if name != "cpu_fallback" else 5
     t0 = time.perf_counter()
     for _ in range(steps):
         state, metrics = step(state, batch)
-    jax.block_until_ready(metrics["loss"])
+    float(metrics["loss"])
     dt = time.perf_counter() - t0
 
     tokens = steps * cfg.batch_size * cfg.seq_length

@@ -66,6 +66,10 @@ class Config:
     dense_start_layers: int = 2
     dense_end_layers: int = 2
     expert_output_scaling: float = 1.0
+    # 'sort' = scatter/gather dispatch via flat slot ids (linear memory, the
+    # default); 'einsum' = GShard one-hot dispatch (O(S·E·C) memory, MXU-only
+    # data movement — useful for A/B in bench_ops).
+    moe_dispatch: str = "sort"
 
     # --- MoD (mixture of depths) ---
     use_mod: bool = False
@@ -90,6 +94,10 @@ class Config:
     min_lr: float = 1e-6
     precision: str = "auto"  # auto|fp32|bf16|mixed_bf16|mixed_fp16
     inference_precision: str = "auto"
+    # Weight-only inference quantization (training/quantization.py):
+    # None | 'int8' | 'int4' (ref trainer.py:575 QuantizationManager).
+    quantization_method: Optional[str] = None
+    quantization_bits: int = 8
     gradient_checkpointing: bool = True
     remat_policy: str = "nothing_saveable"  # nothing_saveable|dots_saveable|full
     scan_layers: bool = False  # lax.scan over layers (homogeneous stacks)
@@ -99,6 +107,11 @@ class Config:
     assistant_loss_weight: float = 1.5
     z_loss_weight: float = 0.0
     label_smoothing: float = 0.0
+    # Fuse the LM head matmul into the CE loss, chunked over the sequence —
+    # full [B,S,V] logits never materialize (ops/fused.py). The single
+    # biggest HBM saving at large vocab; disable only for debugging.
+    fused_lm_head_ce: bool = True
+    loss_chunk_size: int = 256
 
     # --- Parallelism (replaces ref DeepSpeed/FSDP/ColossalAI group) ---
     # Axis order = physical torus placement: trailing axes land on the
@@ -161,6 +174,12 @@ class Config:
     emergency_override_enabled: bool = True
     log_lr_decisions: bool = True
     enable_architecture_evolution: bool = False
+    # Runtime capacity-factor / routing-temperature tuning (each change
+    # recompiles the step; ref trainer.py:1450,1471).
+    enable_moe_routing_optimization: bool = True
+    # Gradient-noise-driven effective-batch growth (recompiles + reshapes
+    # the data contract; opt-in; ref trainer.py:1626).
+    enable_batch_size_optimization: bool = False
     intervention_cooldown_steps: int = 200
 
     # --- Chinchilla scaling ---
@@ -206,12 +225,21 @@ class Config:
                 f"invalid moe_pattern {self.moe_pattern}"
             )
             assert self.capacity_factor > 0
+            assert self.moe_dispatch in ("sort", "einsum"), (
+                f"invalid moe_dispatch {self.moe_dispatch}"
+            )
         if self.use_mod:
             assert 0.0 < self.mod_capacity_factor <= 1.0, (
                 "mod_capacity_factor must be in (0, 1]"
             )
         if self.sequence_parallel_size > 1:
             assert self.seq_length % self.sequence_parallel_size == 0
+            assert self.use_ring_attention, (
+                "sequence_parallel_size > 1 requires use_ring_attention=True "
+                "(without it every device re-gathers the full sequence, "
+                "defeating sequence parallelism)"
+            )
+        assert self.loss_chunk_size > 0, "loss_chunk_size must be positive"
         for axis in ("fsdp", "expert", "tensor", "sequence"):
             size = getattr(self, f"{axis}_parallel_size")
             assert size >= 1, f"{axis}_parallel_size must be >= 1"
@@ -726,17 +754,26 @@ class ConfigManager:
         # divided across the model-sharding axes. Grow tp while one chip
         # can't hold its shard (norm+embed replicas bound fsdp's reach).
         state_gb = config.estimate_parameters() * 12 / 1e9
+        shards = max(1, remaining)  # model-parallel ways left after ep
         tp = 1
+
+        def per_chip_gb(tp_size: int) -> float:
+            # ~75% of state is fsdp-shardable everywhere; ~25% (embeddings,
+            # fused projections) only truly shards across tp. Monotonically
+            # decreasing in tp at fixed total shards, so the loop below
+            # terminates at the minimal tp that fits (or the caps).
+            fsdp = max(1, shards // tp_size)
+            return state_gb * (0.75 / (tp_size * fsdp) + 0.25 / tp_size)
+
         while (
-            state_gb / max(1, remaining) > hbm_gb * 0.5
-            and remaining >= 2
+            per_chip_gb(tp) > hbm_gb * 0.5
+            and tp * 2 <= shards
             and tp < 8
             and config.num_heads % (tp * 2) == 0
         ):
             tp *= 2
-            remaining //= 2
         updates["tensor_parallel_size"] = tp
-        updates["fsdp_parallel_size"] = remaining
+        updates["fsdp_parallel_size"] = shards // tp
         return dataclasses.replace(config, **updates)
 
     @staticmethod

@@ -29,6 +29,66 @@ from luminaai_tpu.models.layers import default_init
 Dtype = Any
 
 
+def _sort_routing(
+    router_probs: jax.Array, top_k: int, capacity: int
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Sort-based top-k assignment with per-expert capacity (no [S,E,C] maps).
+
+    Replicates _top_k_routing's greedy semantics exactly — capacity is
+    granted round-major (all tokens' 1st choices in sequence order, then 2nd
+    choices, ...) — but via an O(S·k log(S·k)) sort per group instead of
+    O(S·E·C) one-hot dispatch/combine tensors. At flagship scale the one-hot
+    formulation allocates 2×[G,S,E,C]≈670MB per MoE layer (r2 OOM driver);
+    here routing state is three [G,S,k] integer/float arrays. The expert
+    buffers are then built with scatter/gather (VPU) while the FFN matmuls
+    stay dense [E,G,C,·] on the MXU. (Ref's CUDA dispatch kernels play this
+    role: Src/Main_Scripts/core/moe_cuda_wrapper.py:628.)
+
+    router_probs: [G, S, E] softmax probabilities.
+    Returns (per group, vmapped):
+      slot:  [G, S, k] int32 flat slot e*C + pos (E*C = dropped sentinel)
+      gate:  [G, S, k] renormalized top-k probs (zeroed where dropped)
+      dropped: [G, S] 1.0 where a token lost ≥1 of its k slots
+      counts: [G, E] kept tokens per expert
+    """
+    G, S, E = router_probs.shape
+    C = capacity
+
+    def per_group(probs):  # [S, E]
+        vals, choice = jax.lax.top_k(probs, top_k)  # [S, k] desc order
+        denom = vals.sum(-1, keepdims=True) + 1e-9
+        gates = vals / denom
+        # Pair index p = round*S + s → round-major FIFO priority, matching
+        # the greedy loop (round r assigned before r+1, sequence order
+        # within a round).
+        e_flat = choice.T.reshape(S * top_k)  # [S*k], p = r*S + s
+        order = jnp.argsort(e_flat * (S * top_k) + jnp.arange(S * top_k))
+        e_sorted = e_flat[order]
+        # Position within the expert's buffer = rank - first rank of that
+        # expert's run (offsets from exclusive-cumsum of counts).
+        counts_all = jnp.sum(
+            jax.nn.one_hot(e_flat, E, dtype=jnp.int32), axis=0
+        )  # [E] (pre-capacity)
+        starts = jnp.cumsum(counts_all) - counts_all
+        pos_sorted = jnp.arange(S * top_k) - starts[e_sorted]
+        keep_sorted = pos_sorted < C
+        slot_sorted = jnp.where(
+            keep_sorted, e_sorted * C + pos_sorted, E * C
+        ).astype(jnp.int32)
+        # Un-sort back to pair order, then to [S, k].
+        slot_flat = jnp.zeros(S * top_k, jnp.int32).at[order].set(slot_sorted)
+        slot = slot_flat.reshape(top_k, S).T  # [S, k]
+        keep = slot < E * C
+        gate = jnp.where(keep, gates, 0.0)
+        dropped = jnp.clip(
+            jnp.sum(1.0 - keep.astype(probs.dtype), axis=-1), 0.0, 1.0
+        )
+        counts = jnp.minimum(counts_all, C)
+        return slot, gate, dropped, counts
+
+    return jax.vmap(per_group)(router_probs)
+
+
 def _top_k_routing(
     router_probs: jax.Array, top_k: int, capacity: int
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
@@ -138,12 +198,41 @@ class MoELayer(nn.Module):
             gate_logits = gate_logits + noise
         router_probs = jax.nn.softmax(gate_logits, axis=-1)
 
-        dispatch, combine, dropped = _top_k_routing(router_probs, k, capacity)
-        dispatch = dispatch.astype(self.dtype)
-        combine = combine.astype(self.dtype)
+        if cfg.moe_dispatch == "sort":
+            # Sort-based dispatch: scatter/gather via flat slot ids — no
+            # [G,S,E,C] one-hot tensors (see _sort_routing). The expert FFN
+            # below still runs dense [E,G,C,·] matmuls on the MXU.
+            slot, gate, dropped, counts = _sort_routing(
+                router_probs, k, capacity
+            )
+            gate = gate.astype(self.dtype)
+            tok = jnp.broadcast_to(
+                jnp.arange(S)[:, None], (S, k)
+            ).reshape(-1)
 
-        # --- Dispatch → expert FFN → combine (all einsums) ---
-        expert_in = jnp.einsum("gsec,gsh->egch", dispatch, x)
+            def scatter_group(xg, slot_g):
+                # Spill row E*C absorbs dropped pairs, sliced off after.
+                buf = jnp.zeros((E * capacity + 1, H), dtype=self.dtype)
+                return buf.at[slot_g.reshape(-1)].set(xg[tok])
+
+            buf = jax.vmap(scatter_group)(x.astype(self.dtype), slot)
+            expert_in = (
+                buf[:, : E * capacity]
+                .reshape(G, E, capacity, H)
+                .transpose(1, 0, 2, 3)
+            )
+            tokens_per_expert = counts.astype(jnp.float32).sum(axis=0)
+        else:
+            dispatch, combine_w, dropped = _top_k_routing(
+                router_probs, k, capacity
+            )
+            dispatch = dispatch.astype(self.dtype)
+            combine_w = combine_w.astype(self.dtype)
+            expert_in = jnp.einsum("gsec,gsh->egch", dispatch, x)
+            tokens_per_expert = jnp.einsum(
+                "gsec->e", dispatch.astype(jnp.float32)
+            )
+
         expert_in = nn.with_logical_constraint(
             expert_in, ("expert", "activation_exp_batch", None, None)
         )
@@ -154,13 +243,27 @@ class MoELayer(nn.Module):
         expert_out = nn.with_logical_constraint(
             expert_out, ("expert", "activation_exp_batch", None, None)
         )
-        out = jnp.einsum("gsec,egch->gsh", combine, expert_out)
+
+        if cfg.moe_dispatch == "sort":
+            out_flat = expert_out.transpose(1, 0, 2, 3).reshape(
+                G, E * capacity, H
+            )
+            out_flat = jnp.concatenate(
+                [out_flat, jnp.zeros((G, 1, H), dtype=self.dtype)], axis=1
+            )
+
+            def combine_group(of, slot_g, gate_g):
+                y = of[slot_g.reshape(-1)].reshape(S, k, H)
+                return jnp.einsum("skh,sk->sh", y, gate_g)
+
+            out = jax.vmap(combine_group)(out_flat, slot, gate)
+        else:
+            out = jnp.einsum("gsec,egch->gsh", combine_w, expert_out)
         if cfg.expert_output_scaling != 1.0:
             out = out * cfg.expert_output_scaling
 
         # --- Aux losses + stats (ref :1244) ---
         # f_e: fraction of tokens whose slot went to expert e; P_e: mean prob.
-        tokens_per_expert = jnp.einsum("gsec->e", dispatch.astype(jnp.float32))
         f = tokens_per_expert / (G * S * k + 1e-9)
         p = router_probs.mean(axis=(0, 1))
         aux_loss = jnp.clip(

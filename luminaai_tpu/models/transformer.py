@@ -220,6 +220,7 @@ class LuminaTransformer(nn.Module):
         kv_caches: Optional[List[Tuple[jax.Array, jax.Array]]] = None,
         cache_index: Optional[jax.Array] = None,
         deterministic: bool = True,
+        return_hidden: bool = False,
     ):
         cfg = self.config
         embedder = Embedder(cfg, dtype=self.dtype, name="embedder")
@@ -244,10 +245,16 @@ class LuminaTransformer(nn.Module):
         else:
             block_cls = TransformerBlock
             if remat_on:
+                # prevent_cse=True is required here: under a plain layer loop
+                # XLA would CSE the recomputation against the forward values,
+                # keeping every layer's activations alive into the backward
+                # pass (observed as per-layer MoE temps coexisting in the r2
+                # flagship OOM). Inside nn.scan (below) False is safe — the
+                # loop boundary already blocks CSE.
                 block_cls = nn.remat(
                     TransformerBlock,
                     policy=policy,
-                    prevent_cse=False,
+                    prevent_cse=True,
                     static_argnums=(),
                 )
             new_caches = []
@@ -272,6 +279,11 @@ class LuminaTransformer(nn.Module):
                     all_metrics.append(metrics)
 
         x = RMSNorm(cfg.rms_norm_eps, dtype=self.dtype, name="final_norm")(x)
+        if return_hidden:
+            # Caller fuses the LM head into the loss (ops/fused.py
+            # fused_lm_head_cross_entropy) — full [B,S,V] logits never exist.
+            aux = self._reduce_metrics(all_metrics)
+            return x, aux
         logits = embedder.decode(x)
         logits = nn.with_logical_constraint(
             logits, ("activation_batch", "activation_length", "activation_vocab")

@@ -213,7 +213,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
 
 
 def _bwd(scale, causal, block_q, block_kv, res, g):
-    q, k, v, out, lse = res
+    q, k, v, out, lse_small = res
     do = g
     B, Sq, Hq, D = q.shape
     _, Skv, Hkv, _ = k.shape
@@ -224,6 +224,9 @@ def _bwd(scale, causal, block_q, block_kv, res, g):
     vt = v.transpose(0, 2, 1, 3)
     dot = do.transpose(0, 2, 1, 3)
     ot = out.transpose(0, 2, 1, 3)
+    # Residual lse is compact [B, Hq, Sq]; re-expand to the kernel's
+    # lane-replicated layout only for the lifetime of the bwd kernels.
+    lse = jnp.broadcast_to(lse_small[..., None], (*lse_small.shape, LANES))
     delta = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[..., None], (*delta.shape, LANES))
 
@@ -296,7 +299,10 @@ def _flash(q, k, v, scale, causal, block_q, block_kv):
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_kv):
     out, lse = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q, block_kv=block_kv)
-    return out, (q, k, v, out, lse)
+    # Save lse de-replicated: [B, Hq, Sq] fp32 (2MB-scale) instead of the
+    # kernel's [B, Hq, Sq, 128] layout (256MB-scale at flagship shapes) —
+    # the lane-padded buffer lives only inside this fwd call (r1 OOM fix).
+    return out, (q, k, v, out, lse[..., 0])
 
 
 def _flash_bwd(scale, causal, block_q, block_kv, res, g):

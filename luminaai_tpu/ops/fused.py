@@ -64,6 +64,99 @@ def cross_entropy_loss(
     return loss, metrics
 
 
+def fused_lm_head_cross_entropy(
+    hidden: jax.Array,
+    embedding: jax.Array,
+    labels: jax.Array,
+    loss_mask: Optional[jax.Array] = None,
+    loss_weights: Optional[jax.Array] = None,
+    z_loss_weight: float = 0.0,
+    label_smoothing: float = 0.0,
+    chunk_size: int = 256,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """LM head + CE fused over sequence chunks — never materializes [B,S,V].
+
+    The unfused path (embedder.decode → cross_entropy_loss) allocates fp32
+    logits of B·S·V (4GB at B16/S2048/V32k) twice (forward + d_logits): the
+    single largest HBM allocation in the train step. Here the decode matmul
+    and the CE reduction run per sequence chunk inside a `lax.scan`, with the
+    chunk body under `jax.checkpoint`, so only per-chunk logits (B·c·V) ever
+    exist and the backward recomputes them chunk-by-chunk while accumulating
+    d_embedding. Same FLOPs, O(S/c)× less live memory — the scheme the
+    reference approximates with its fused CUDA loss (ref
+    Src/Main_Scripts/training/cuda_kernels.py:91), done the XLA way.
+
+    hidden: [B, S, H] (final-norm output); embedding: [V, H] (tied LM head,
+    fp32); labels/mask/weights as in cross_entropy_loss (caller-shifted).
+    Returns identical (loss, metrics) to the unfused path.
+    """
+    B, S, H = hidden.shape
+    c = max(1, min(chunk_size, S))
+    while S % c:
+        c -= 1
+    n = S // c
+
+    weights = jnp.ones((B, S), dtype=jnp.float32)
+    if loss_mask is not None:
+        weights = weights * loss_mask.astype(jnp.float32)
+    if loss_weights is not None:
+        weights = weights * loss_weights.astype(jnp.float32)
+
+    # [B, S, ...] → [n, B, c, ...] scan layout.
+    def to_chunks(x):
+        return jnp.moveaxis(
+            x.reshape(B, n, c, *x.shape[2:]), 1, 0
+        )
+
+    h_chunks = to_chunks(hidden)
+    l_chunks = to_chunks(labels)
+    w_chunks = to_chunks(weights)
+
+    def chunk_stats(emb, h_c, l_c, w_c):
+        logits = jnp.einsum(
+            "bch,vh->bcv", h_c.astype(jnp.float32), emb.astype(jnp.float32)
+        )
+        lse = jax.nn.logsumexp(logits, axis=-1)  # [B, c]
+        label_logit = jnp.take_along_axis(logits, l_c[..., None], axis=-1)[..., 0]
+        nll = lse - label_logit
+        if label_smoothing > 0.0:
+            smooth = lse - jnp.mean(logits, axis=-1)
+            nll = (1.0 - label_smoothing) * nll + label_smoothing * smooth
+        in_loss = (w_c > 0).astype(jnp.float32)
+        return (
+            (nll * w_c).sum(),
+            w_c.sum(),
+            (jnp.square(lse) * in_loss).sum(),
+            in_loss.sum(),
+        )
+
+    chunk_stats = jax.checkpoint(chunk_stats)
+
+    def body(carry, xs):
+        h_c, l_c, w_c = xs
+        deltas = chunk_stats(embedding, h_c, l_c, w_c)
+        return tuple(a + d for a, d in zip(carry, deltas)), None
+
+    zeros = (jnp.float32(0.0),) * 4
+    (nll_sum, w_sum, z_sum, n_tok), _ = jax.lax.scan(
+        body, zeros, (h_chunks, l_chunks, w_chunks)
+    )
+
+    denom = jnp.maximum(w_sum, 1.0)
+    loss = nll_sum / denom
+    metrics = {
+        "ce_loss": loss,
+        "perplexity": jnp.exp(jnp.clip(loss, max=20.0)),
+        "tokens_in_loss": n_tok,
+    }
+    if z_loss_weight > 0.0:
+        z = z_sum / denom * z_loss_weight
+        loss = loss + z
+        metrics["z_loss"] = z
+    metrics["total_loss"] = loss
+    return loss, metrics
+
+
 def global_norm(grads) -> jax.Array:
     """Global L2 norm over a pytree (ref cuda_kernels.py:253 FusedGradClip;
     the multi-tensor-apply trick is unnecessary under XLA — the tree-wide

@@ -32,8 +32,10 @@ from luminaai_tpu.config import Config
 from luminaai_tpu.ops.fused import (
     clip_by_global_norm,
     cross_entropy_loss,
+    fused_lm_head_cross_entropy,
     global_norm,
 )
+from luminaai_tpu.parallel.mesh import use_mesh
 from luminaai_tpu.parallel.sharding import (
     TrainState,
     batch_spec,
@@ -84,22 +86,57 @@ def _shifted_mask_weights(
     return mask, weights
 
 
+def _ce(
+    config: Config,
+    params,
+    model_out,
+    labels,
+    mask,
+    weights,
+    z_loss_weight: float = 0.0,
+    label_smoothing: float = 0.0,
+):
+    """Route to the fused LM-head CE (chunked, no [B,S,V] logits) or the
+    plain logits path, depending on config.fused_lm_head_ce."""
+    if config.fused_lm_head_ce:
+        hidden = model_out
+        embedding = params["embedder"]["embedding"]
+        if isinstance(embedding, nn.meta.AxisMetadata):
+            embedding = embedding.unbox()  # raw model.init trees are boxed
+        return fused_lm_head_cross_entropy(
+            hidden,
+            embedding,
+            labels,
+            loss_mask=mask,
+            loss_weights=weights,
+            z_loss_weight=z_loss_weight,
+            label_smoothing=label_smoothing,
+            chunk_size=config.loss_chunk_size,
+        )
+    return cross_entropy_loss(
+        model_out,
+        labels,
+        loss_mask=mask,
+        loss_weights=weights,
+        z_loss_weight=z_loss_weight,
+        label_smoothing=label_smoothing,
+    )
+
+
 def make_loss_fn(config: Config, model) -> Callable:
     def loss_fn(params, batch: Batch, rng: jax.Array):
         rngs = {"routing": rng, "dropout": jax.random.fold_in(rng, 1)}
-        logits, aux = model.apply(
+        model_out, aux = model.apply(
             {"params": params},
             batch["input_ids"],
             deterministic=False,
             rngs=rngs,
+            return_hidden=config.fused_lm_head_ce,
         )
         labels, valid = shift_labels(batch)
         mask, weights = _shifted_mask_weights(batch, valid)
-        loss, metrics = cross_entropy_loss(
-            logits,
-            labels,
-            loss_mask=mask,
-            loss_weights=weights,
+        loss, metrics = _ce(
+            config, params, model_out, labels, mask, weights,
             z_loss_weight=config.z_loss_weight,
             label_smoothing=config.label_smoothing,
         )
@@ -188,7 +225,7 @@ def make_train_step(
         return new_state, metrics
 
     def traced(state, batch):
-        with mesh, nn.logical_axis_rules(logical_axis_rules(config)):
+        with use_mesh(mesh), nn.logical_axis_rules(logical_axis_rules(config)):
             return train_step(state, batch)
 
     jitted = jax.jit(
@@ -211,14 +248,15 @@ def make_eval_step(
     """Forward-only eval step: loss + metrics, deterministic routing."""
 
     def eval_loss(params, batch: Batch):
-        logits, aux = model.apply(
-            {"params": params}, batch["input_ids"], deterministic=True
+        model_out, aux = model.apply(
+            {"params": params},
+            batch["input_ids"],
+            deterministic=True,
+            return_hidden=config.fused_lm_head_ce,
         )
         labels, valid = shift_labels(batch)
         mask, weights = _shifted_mask_weights(batch, valid)
-        loss, metrics = cross_entropy_loss(
-            logits, labels, loss_mask=mask, loss_weights=weights,
-        )
+        loss, metrics = _ce(config, params, model_out, labels, mask, weights)
         for k, v in aux.items():
             metrics[k] = v
         metrics["loss"] = loss + aux.get("aux_loss", 0.0)
@@ -227,7 +265,7 @@ def make_eval_step(
     bspec = NamedSharding(mesh, batch_spec())
 
     def traced(state, batch):
-        with mesh, nn.logical_axis_rules(logical_axis_rules(config)):
+        with use_mesh(mesh), nn.logical_axis_rules(logical_axis_rules(config)):
             return eval_loss(state.params, batch)
 
     jitted = jax.jit(traced, in_shardings=(state_shardings, bspec))
