@@ -229,6 +229,38 @@ class GQAttention(nn.Module):
             q, ("activation_batch", "activation_length", "activation_heads", None)
         )
 
+        # Ring attention: sequence/context parallelism. Activations arrive
+        # sequence-sharded (activation_length → 'sequence'); K/V chunks
+        # rotate the ring via ppermute instead of XLA all-gathering the full
+        # sequence onto every device (ops/ring_attention.py).
+        if (
+            cfg.use_ring_attention
+            and cfg.sequence_parallel_size > 1
+            and kv_cache is None
+            # init traces with a batch-1 dummy that can't shard over the
+            # data axes; param shapes don't depend on the attention path.
+            and not self.is_initializing()
+        ):
+            from luminaai_tpu.ops.ring_attention import ring_attention
+            from luminaai_tpu.parallel.mesh import active_mesh
+
+            mesh = active_mesh()
+            if mesh is not None and mesh.shape.get("sequence", 1) > 1:
+                q_spec = nn.logical_to_mesh_axes(
+                    ("activation_batch", "activation_length",
+                     "activation_heads", None)
+                )
+                kv_spec = nn.logical_to_mesh_axes(
+                    ("activation_batch", "activation_length",
+                     "activation_kv_heads", None)
+                )
+                out = ring_attention(
+                    q, k, v, mesh, causal=True,
+                    q_spec=q_spec, kv_spec=kv_spec,
+                )
+                y = jnp.einsum("bshk,hkd->bsd", out, wo.astype(self.dtype))
+                return y, new_cache
+
         use_flash = (
             cfg.use_flash_attention
             and kv_cache is None

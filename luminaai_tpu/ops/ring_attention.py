@@ -1,0 +1,144 @@
+"""Ring attention: sequence/context parallelism over the 'sequence' mesh axis.
+
+The reference scales long context by sharding the batch and relying on
+activation checkpointing (ref: Src/Main_Scripts/core/backend/backend_fsdp.py,
+config_manager.py `sequence length` fields); it has no context parallelism, so
+its max context is bounded by one GPU's memory. Here sequences shard across
+devices: each device holds a contiguous chunk of the sequence, and K/V chunks
+rotate around the ring of devices via `jax.lax.ppermute` (one ICI hop per
+step) while each device accumulates its queries' attention output with the
+online-softmax (flash) recurrence in fp32. Peak memory per device is
+O(S/sp · S/sp) transient per chunk instead of O(S²), and the per-step
+communication (2·B·S/sp·Hkv·D) overlaps with the chunk matmuls — this is the
+standard TPU ring-attention pattern (Liu et al. 2023) built on XLA
+collective-permute over ICI neighbours.
+
+Layout contract: runs inside `shard_map` over the mesh; the caller supplies
+PartitionSpecs (normally derived from the flax logical rules, so batch is
+over (data, fsdp), sequence over 'sequence', heads over 'tensor'). Heads are
+embarrassingly parallel, so tensor parallelism composes freely. Causality is
+enforced with global positions reconstructed from each device's ring index;
+the diagonal chunk guarantees every query row attends to ≥1 key, so the
+final normalisation never divides by zero.
+
+Differentiation: each chunk update is wrapped in `jax.checkpoint`, so the
+backward pass re-computes chunk logits instead of storing [Sq, Skv] blocks
+per ring step — the same FLOPs-for-memory trade the Pallas flash kernel
+makes, and `ppermute` transposes to the reverse rotation automatically.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec
+
+NEG_INF = -1e30
+
+
+def _chunk_update(qg, k, v, kv_idx, m, l, o, *, my_idx, sl_q, causal, scale):
+    """One online-softmax accumulation step against a single K/V chunk.
+
+    qg: [B, Sq, Hkv, G, D] queries (grouped for GQA)
+    k, v: [B, Skv, Hkv, D] current ring chunk
+    kv_idx: scalar ring index of the chunk's home device (global offset)
+    m, l, o: running max / sum / output accumulators (fp32)
+    """
+    logits = (
+        jnp.einsum("bqhgd,bkhd->bqhgk", qg, k).astype(jnp.float32) * scale
+    )
+    if causal:
+        sk = k.shape[1]
+        q_pos = my_idx * sl_q + jnp.arange(sl_q)
+        k_pos = kv_idx * sk + jnp.arange(sk)
+        mask = q_pos[:, None] >= k_pos[None, :]
+        logits = jnp.where(mask[None, :, None, None, :], logits, NEG_INF)
+    m_new = jnp.maximum(m, logits.max(axis=-1))
+    corr = jnp.exp(m - m_new)
+    p = jnp.exp(logits - m_new[..., None])
+    l_new = l * corr + p.sum(axis=-1)
+    pv = jnp.einsum("bqhgk,bkhd->bqhgd", p.astype(v.dtype), v)
+    o_new = o * corr[..., None] + pv.astype(jnp.float32)
+    return m_new, l_new, o_new
+
+
+def _ring_attention_shard(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    axis_size: int,
+    causal: bool,
+) -> jax.Array:
+    """Per-shard body (inside shard_map). q: [B, Sl, Hq, D]; k/v: [B, Sl, Hkv, D]."""
+    B, Sl, Hq, D = q.shape
+    Hkv = k.shape[2]
+    G = Hq // Hkv
+    scale = 1.0 / (D**0.5)
+    my_idx = jax.lax.axis_index(axis_name)
+    qg = q.reshape(B, Sl, Hkv, G, D)
+
+    m = jnp.full((B, Sl, Hkv, G), NEG_INF, dtype=jnp.float32)
+    l = jnp.zeros((B, Sl, Hkv, G), dtype=jnp.float32)
+    o = jnp.zeros((B, Sl, Hkv, G, D), dtype=jnp.float32)
+
+    update = jax.checkpoint(
+        functools.partial(
+            _chunk_update, my_idx=my_idx, sl_q=Sl, causal=causal, scale=scale
+        )
+    )
+    # Rotation: after s permutes, device i holds the chunk born on (i - s) % n.
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    for step in range(axis_size):
+        kv_idx = (my_idx - step) % axis_size
+        m, l, o = update(qg, k, v, kv_idx, m, l, o)
+        if step + 1 < axis_size:
+            k = jax.lax.ppermute(k, axis_name, perm)
+            v = jax.lax.ppermute(v, axis_name, perm)
+
+    out = o / l[..., None]
+    return out.astype(q.dtype).reshape(B, Sl, Hq, D)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = True,
+    axis_name: str = "sequence",
+    q_spec: Optional[PartitionSpec] = None,
+    kv_spec: Optional[PartitionSpec] = None,
+) -> jax.Array:
+    """Sequence-parallel attention over `axis_name` of `mesh`.
+
+    q: [B, S, Hq, D]; k/v: [B, S, Hkv, D] — global (pjit-view) arrays with S
+    divisible by the axis size. q_spec/kv_spec describe how the caller's
+    activations map onto the mesh (default: batch over (data, fsdp), length
+    over the ring axis, heads unsharded). Returns [B, S, Hq, D].
+    """
+    axis_size = mesh.shape[axis_name]
+    if q_spec is None:
+        q_spec = PartitionSpec(("data", "fsdp"), axis_name, None, None)
+    if kv_spec is None:
+        kv_spec = PartitionSpec(("data", "fsdp"), axis_name, None, None)
+
+    fn = functools.partial(
+        _ring_attention_shard,
+        axis_name=axis_name,
+        axis_size=axis_size,
+        causal=causal,
+    )
+    sharded = jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec),
+        out_specs=q_spec,
+        check_vma=False,
+    )
+    return sharded(q, k, v)

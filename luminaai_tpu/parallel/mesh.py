@@ -95,6 +95,52 @@ def build_mesh(
     return Mesh(device_array, axes)
 
 
+# Explicit registry for the mesh the current trace runs under. The train
+# step factories push here (use_mesh below); thread_resources is only a
+# legacy fallback for code that entered `with mesh:` directly.
+import contextlib
+import threading
+
+_ACTIVE_MESH = threading.local()
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    """`with mesh:` plus registration for active_mesh()."""
+    prev = getattr(_ACTIVE_MESH, "mesh", None)
+    _ACTIVE_MESH.mesh = mesh
+    try:
+        with mesh:
+            yield mesh
+    finally:
+        _ACTIVE_MESH.mesh = prev
+
+
+def active_mesh() -> Optional[Mesh]:
+    """The Mesh whose use_mesh()/`with mesh:` context encloses the caller.
+
+    Model code that needs explicit collectives (ring attention's shard_map)
+    runs under the train step's trace context; this recovers that mesh
+    without threading it through every flax module attribute. Checks the
+    explicit registry first; falls back to the (deprecated) global mesh
+    context for callers that used `with mesh:` directly.
+    """
+    mesh = getattr(_ACTIVE_MESH, "mesh", None)
+    if mesh is not None:
+        return mesh
+    try:
+        import warnings
+
+        from jax.interpreters import pxla
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", DeprecationWarning)
+            m = pxla.thread_resources.env.physical_mesh
+        return None if m.empty else m
+    except Exception:  # API moved/removed; no implicit context available
+        return None
+
+
 def initialize_multihost(config: Config) -> None:
     """Bring up the JAX distributed runtime for multi-host training.
 

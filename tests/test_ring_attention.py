@@ -1,0 +1,112 @@
+"""Ring attention (sequence/context parallelism) tests on the 8-dev CPU mesh.
+
+SURVEY.md §2 item 21 / §4 sharding strategy: sp shards must jit + run, and
+the sequence-parallel result must match the single-device computation —
+here checked at op level (vs a plain softmax reference, values and grads)
+and at model level (sp=2 train-step loss equals sp=1).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec
+
+from luminaai_tpu.config import Config
+from luminaai_tpu.ops.ring_attention import ring_attention
+from tests.test_sharding import make_batch, run_one_step, tiny_config
+
+
+def reference_attention(q, k, v, causal=True):
+    """Plain softmax attention with GQA head grouping, fp32."""
+    B, S, Hq, D = q.shape
+    Hkv = k.shape[2]
+    g = Hq // Hkv
+    qg = q.reshape(B, S, Hkv, g, D).astype(jnp.float32)
+    logits = jnp.einsum("bqhgd,bkhd->bqhgk", qg, k.astype(jnp.float32))
+    logits = logits / np.sqrt(D)
+    if causal:
+        mask = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
+        logits = jnp.where(mask[None, :, None, None, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bqhgk,bkhd->bqhgd", probs, v.astype(jnp.float32))
+    return out.reshape(B, S, Hq, D).astype(q.dtype)
+
+
+def seq_mesh(sp: int) -> Mesh:
+    devs = np.asarray(jax.devices()[: sp * 2]).reshape(2, 1, sp)
+    return Mesh(devs, ("data", "fsdp", "sequence"))
+
+
+def rand_qkv(B=2, S=32, Hq=4, Hkv=2, D=16, seed=0):
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(B, S, Hq, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, S, Hkv, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, S, Hkv, D), jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("sp", [2, 4])
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_matches_reference(sp, causal):
+    q, k, v = rand_qkv()
+    mesh = seq_mesh(sp)
+    out = ring_attention(q, k, v, mesh, causal=causal)
+    ref = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_gradients_match_reference():
+    q, k, v = rand_qkv(S=16, seed=1)
+    mesh = seq_mesh(2)
+    tangent = jnp.asarray(
+        np.random.RandomState(2).randn(*q.shape), jnp.float32
+    )
+
+    def ring_loss(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh, causal=True) * tangent)
+
+    def ref_loss(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=True) * tangent)
+
+    g_ring = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_ring, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=3e-5, err_msg=f"d{name}"
+        )
+
+
+def test_ring_single_shard_degenerates():
+    """sp=1 mesh: no permutes, plain flash recurrence — sanity floor."""
+    q, k, v = rand_qkv(S=8)
+    devs = np.asarray(jax.devices()[:1]).reshape(1, 1, 1)
+    mesh = Mesh(devs, ("data", "fsdp", "sequence"))
+    out = ring_attention(q, k, v, mesh, causal=True)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_model_sp2_matches_sp1():
+    """Full train step under sp=2 + ring == sp=1 loss (same data/init)."""
+    losses = {}
+    for name, kw in {
+        "sp1": {},
+        "sp2": dict(sequence_parallel_size=2, use_ring_attention=True),
+    }.items():
+        cfg = tiny_config(**kw)
+        _, metrics, _ = run_one_step(cfg)
+        losses[name] = float(metrics["ce_loss"])
+    assert abs(losses["sp1"] - losses["sp2"]) < 5e-2, losses
+
+
+def test_model_sp_with_tp_and_fsdp():
+    """sp composes with tensor and fsdp axes in one mesh."""
+    cfg = tiny_config(
+        sequence_parallel_size=2,
+        use_ring_attention=True,
+        tensor_parallel_size=2,
+        fsdp_parallel_size=2,
+    )
+    _, metrics, _ = run_one_step(cfg)
+    assert np.isfinite(float(metrics["loss"]))
