@@ -109,10 +109,21 @@ class GenerationEngine:
         max_context: Optional[int] = None,
     ):
         self.model = model
-        self.params = params
         self.tokenizer = tokenizer
         self.config = config or model.config
         self.max_context = max_context or self.config.seq_length
+        # Weight-only inference quantization (config.quantization_method =
+        # 'int8'/'int4'; ref trainer.py:575): weights round-trip through int
+        # codes here — compute stays bf16 on the MXU (the bnb trade).
+        self.quantization_info: dict = {}
+        if getattr(self.config, "quantization_method", None):
+            from luminaai_tpu.training.quantization import QuantizationManager
+
+            manager = QuantizationManager(self.config)
+            qparams = manager.quantize_for_inference(params)
+            params = manager.materialize(qparams, model.dtype)
+            self.quantization_info = manager.quantization_info
+        self.params = params
         self._decode_fn = {}  # keyed by generation kwargs (static args)
         self._prefill_fn = functools.lru_cache(maxsize=16)(self._make_prefill)
 
