@@ -1,0 +1,194 @@
+"""Transformer-op microbenchmarks (ref: Src/Main_Scripts/core/
+benchmark_transformer_ops.py, training/benchmark_cuda_kernels.py:433).
+
+Times the repo's competing op implementations head-to-head on the current
+backend (real TPU under the default platform; CPU with JAX_PLATFORMS=cpu):
+
+  - attention: Pallas flash kernel vs XLA einsum fallback (fwd and fwd+bwd)
+  - MoE dispatch: sort (scatter/gather) vs einsum (one-hot) (fwd and fwd+bwd)
+  - loss: fused LM-head CE (chunked) vs plain logits CE (fwd+bwd)
+
+Prints one human-readable table plus a final JSON line for tooling. Timing
+boundaries force a host transfer (float/device_get) — block_until_ready
+alone can return early under the tunneled TPU backend.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Callable, Dict, List
+
+import numpy as np
+
+
+def _time_fn(fn: Callable, *args, iters: int = 10, warmup: int = 2) -> float:
+    """Median wall seconds per call; each call synced via host transfer."""
+    import jax
+
+    def run_once() -> float:
+        t0 = time.perf_counter()
+        out = fn(*args)
+        leaf = jax.tree.leaves(out)[0]
+        np.asarray(jax.device_get(leaf)).ravel()[:1]  # force completion
+        return time.perf_counter() - t0
+
+    for _ in range(warmup):
+        run_once()
+    return float(np.median([run_once() for _ in range(iters)]))
+
+
+def bench_attention(B=4, S=2048, Hq=16, Hkv=8, D=64) -> List[Dict]:
+    import jax
+    import jax.numpy as jnp
+
+    from luminaai_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, S, Hq, D), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(B, S, Hkv, D), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(B, S, Hkv, D), jnp.bfloat16)
+
+    def xla_attn(q, k, v):
+        g = Hq // Hkv
+        qg = q.reshape(B, S, Hkv, g, D)
+        logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k).astype(jnp.float32)
+        logits = logits / np.sqrt(D)
+        mask = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
+        logits = jnp.where(mask[None, None, None], logits, -1e30)
+        p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhgqk,bkhd->bqhgd", p, v).reshape(B, S, Hq, D)
+
+    flash = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+    xla = jax.jit(xla_attn)
+
+    def grad_wrap(f):
+        return jax.jit(
+            jax.grad(lambda q, k, v: f(q, k, v).astype(jnp.float32).sum(),
+                     argnums=(0, 1, 2))
+        )
+
+    rows = []
+    for name, f in (("flash", flash), ("xla", xla)):
+        rows.append({
+            "op": f"attention_{name}_fwd",
+            "ms": _time_fn(f, q, k, v) * 1e3,
+            "shape": f"B{B}xS{S}xH{Hq}/{Hkv}xD{D}",
+        })
+    for name, f in (("flash", grad_wrap(flash)), ("xla", grad_wrap(xla))):
+        rows.append({
+            "op": f"attention_{name}_fwdbwd",
+            "ms": _time_fn(f, q, k, v) * 1e3,
+            "shape": f"B{B}xS{S}xH{Hq}/{Hkv}xD{D}",
+        })
+    return rows
+
+
+def bench_moe_dispatch(G=8, S=2048, H=512, E=8, k=2, F=1408) -> List[Dict]:
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from luminaai_tpu.config import Config
+    from luminaai_tpu.models.moe import MoELayer
+
+    cfg = Config(
+        vocab_size=1024, hidden_size=H, num_layers=2, num_heads=8,
+        num_kv_heads=4, seq_length=S, batch_size=G, use_moe=True,
+        num_experts=E, moe_top_k=k, intermediate_size=F,
+        use_flash_attention=False, gradient_checkpointing=False,
+    )
+    x = jnp.asarray(
+        np.random.RandomState(0).randn(G, S, H), jnp.bfloat16
+    )
+
+    rows = []
+    for mode in ("sort", "einsum"):
+        c = dataclasses.replace(cfg, moe_dispatch=mode)
+        layer = MoELayer(c)
+        params = layer.init(jax.random.key(0), x)
+        fwd = jax.jit(lambda p, x: layer.apply(p, x)[0])
+        bwd = jax.jit(jax.grad(
+            lambda p, x: layer.apply(p, x)[0].astype(jnp.float32).sum()
+        ))
+        rows.append({
+            "op": f"moe_{mode}_fwd",
+            "ms": _time_fn(fwd, params, x) * 1e3,
+            "shape": f"G{G}xS{S}xH{H} E{E}k{k}",
+        })
+        rows.append({
+            "op": f"moe_{mode}_fwdbwd",
+            "ms": _time_fn(bwd, params, x) * 1e3,
+            "shape": f"G{G}xS{S}xH{H} E{E}k{k}",
+        })
+    return rows
+
+
+def bench_loss(B=8, S=2048, H=1024, V=32768) -> List[Dict]:
+    import jax
+    import jax.numpy as jnp
+
+    from luminaai_tpu.ops.fused import (
+        cross_entropy_loss,
+        fused_lm_head_cross_entropy,
+    )
+
+    rng = np.random.RandomState(0)
+    hidden = jnp.asarray(rng.randn(B, S, H) * 0.02, jnp.bfloat16)
+    emb = jnp.asarray(rng.randn(V, H) * 0.02, jnp.float32)
+    labels = jnp.asarray(rng.randint(0, V, (B, S)), jnp.int32)
+
+    def plain(hidden, emb):
+        logits = jnp.einsum(
+            "bsh,vh->bsv", hidden.astype(jnp.float32), emb
+        )
+        return cross_entropy_loss(logits, labels)[0]
+
+    def fused(hidden, emb):
+        return fused_lm_head_cross_entropy(hidden, emb, labels)[0]
+
+    rows = []
+    for name, f in (("fused", fused), ("plain", plain)):
+        g = jax.jit(jax.grad(f, argnums=(0, 1)))
+        rows.append({
+            "op": f"lm_head_ce_{name}_fwdbwd",
+            "ms": _time_fn(g, hidden, emb) * 1e3,
+            "shape": f"B{B}xS{S}xH{H}xV{V}",
+        })
+    return rows
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--suite", default="all", choices=["all", "attention", "moe", "loss"]
+    )
+    parser.add_argument("--small", action="store_true",
+                        help="CPU-sized shapes for smoke testing")
+    args = parser.parse_args()
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    rows: List[Dict] = []
+    if args.suite in ("all", "attention"):
+        rows += bench_attention(**(dict(B=1, S=256, Hq=4, Hkv=2, D=64)
+                                   if args.small else {}))
+    if args.suite in ("all", "moe"):
+        rows += bench_moe_dispatch(**(dict(G=2, S=256, H=128, F=256)
+                                      if args.small else {}))
+    if args.suite in ("all", "loss"):
+        rows += bench_loss(**(dict(B=2, S=256, H=128, V=2048)
+                              if args.small else {}))
+
+    width = max(len(r["op"]) for r in rows)
+    print(f"\n{'op':<{width}}  {'ms':>10}  shape   [{platform}]")
+    for r in rows:
+        print(f"{r['op']:<{width}}  {r['ms']:>10.3f}  {r['shape']}")
+    print(json.dumps({"platform": platform, "results": rows}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,345 @@
+"""Dataset acquisition: OASST tree extraction + shard writing + downloads.
+
+Covers the reference's Dataset_download.py (ref: Src/Main_Scripts/
+Dataset_download.py:49 build_conversation_tree, :72
+extract_conversation_paths, :98 format_conversation, :124
+filter_quality_conversations, :203 save_conversations_with_size_limit, :278
+download_and_process_conversations) and the download half of
+multi_source_dataset.py. The processing pipeline (tree → paths → filter →
+shard) is pure and runs offline; the network edge is isolated behind
+`fetch_raw` / `network_available` so an air-gapped TPU pod degrades to
+processing local dumps instead of crashing mid-pipeline.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import urllib.request
+from pathlib import Path
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+OASST_DATASET = "OpenAssistant/oasst2"
+
+# Raw-dump URL templates for the multi-source pipeline's corpora (ref
+# multi_source_dataset.py WikipediaProcessor.download_dump etc.).
+SOURCE_URLS: Dict[str, str] = {
+    "wikipedia": (
+        "https://dumps.wikimedia.org/{lang}/latest/"
+        "{lang}-latest-pages-articles.xml.bz2"
+    ),
+    "gutenberg": "https://www.gutenberg.org/files/{book_id}/{book_id}-0.txt",
+    "arxiv": (
+        "http://export.arxiv.org/api/query?search_query=cat:{category}"
+        "&max_results={max_results}"
+    ),
+    "stackoverflow": (
+        "https://api.stackexchange.com/2.3/questions?site=stackoverflow"
+        "&tagged={tag}&pagesize={page_size}&filter=withbody"
+    ),
+}
+
+
+# ---------------------------------------------------------------------------
+# Pure processing (offline): OASST message tree → conversation paths
+# ---------------------------------------------------------------------------
+def build_conversation_tree(
+    messages: List[Dict],
+) -> Tuple[Dict[str, Dict], List[str]]:
+    """Message list → {id: {data, children}} map + root ids (ref :49)."""
+    message_map: Dict[str, Dict] = {}
+    for msg in messages:
+        message_map[msg["message_id"]] = {"data": msg, "children": []}
+    roots = []
+    for msg in messages:
+        parent_id = msg.get("parent_id")
+        if parent_id and parent_id in message_map:
+            message_map[parent_id]["children"].append(msg["message_id"])
+        else:
+            roots.append(msg["message_id"])
+    return message_map, roots
+
+
+def extract_conversation_paths(
+    message_map: Dict[str, Dict], root_id: str
+) -> List[List[Dict]]:
+    """All root→node paths with ≥2 messages (ref :72). Iterative DFS —
+    OASST trees can be deep enough to threaten the recursion limit."""
+    paths: List[List[Dict]] = []
+    if root_id not in message_map:
+        return paths
+    stack: List[Tuple[str, List[Dict]]] = [(root_id, [])]
+    while stack:
+        node_id, prefix = stack.pop()
+        node = message_map.get(node_id)
+        if node is None:
+            continue
+        path = prefix + [node["data"]]
+        if len(path) >= 2:
+            paths.append(path)
+        for child_id in node["children"]:
+            stack.append((child_id, path))
+    return paths
+
+
+def format_conversation(messages: List[Dict]) -> Dict:
+    """Path → structured conversation record (ref :98)."""
+    conversation = {
+        "conversation_id": messages[0].get("message_tree_id", ""),
+        "messages": [],
+        "total_turns": len(messages),
+        "languages": sorted({m.get("lang", "en") for m in messages}),
+    }
+    for i, msg in enumerate(messages):
+        conversation["messages"].append({
+            "turn": i + 1,
+            "role": (msg.get("role") or "").lower(),
+            "content": (msg.get("text") or "").strip(),
+            "message_id": msg.get("message_id", ""),
+            "rank": msg.get("rank", 0) or 0,
+            "synthetic": bool(msg.get("synthetic", False)),
+        })
+    return conversation
+
+
+def filter_quality_conversations(
+    conversations: List[Dict], strict: bool = False
+) -> List[Dict]:
+    """Quality gate (ref :124): role alternation sanity, non-empty content,
+    length bounds; strict mode also requires English and ≥2 exchanges."""
+    kept = []
+    for conv in conversations:
+        msgs = conv.get("messages", [])
+        if len(msgs) < 2:
+            continue
+        roles = [m.get("role") for m in msgs]
+        if roles[0] != "prompter" and roles[0] != "user":
+            continue
+        if not any(r == "assistant" for r in roles):
+            continue
+        # Paths are emitted at every tree depth; drop prefixes that end on
+        # an unanswered prompt (no assistant-loss signal in the final turn).
+        if roles[-1] != "assistant":
+            continue
+        contents = [(m.get("content") or "") for m in msgs]
+        if any(not c.strip() for c in contents):
+            continue
+        total_chars = sum(len(c) for c in contents)
+        if total_chars < 20 or total_chars > 100_000:
+            continue
+        if strict:
+            if len(msgs) < 4:
+                continue
+            if conv.get("languages") and "en" not in conv["languages"]:
+                continue
+        kept.append(conv)
+    return kept
+
+
+def oasst_to_chat_format(conversation: Dict) -> Dict:
+    """OASST roles → the repo's chat schema ({'messages': [{role, content}]},
+    prompter→user) consumed by ConversationTokenizer."""
+    role_map = {"prompter": "user", "assistant": "assistant", "user": "user"}
+    return {
+        "messages": [
+            {"role": role_map.get(m["role"], m["role"]),
+             "content": m["content"]}
+            for m in conversation["messages"]
+        ]
+    }
+
+
+def analyze_conversations(
+    conversations: List[Dict], split_name: str = ""
+) -> Dict[str, Any]:
+    """Corpus stats (ref :166)."""
+    if not conversations:
+        return {"split": split_name, "count": 0}
+    turns = [c.get("total_turns", len(c.get("messages", [])))
+             for c in conversations]
+    chars = [
+        sum(len(m.get("content") or "") for m in c.get("messages", []))
+        for c in conversations
+    ]
+    return {
+        "split": split_name,
+        "count": len(conversations),
+        "avg_turns": sum(turns) / len(turns),
+        "max_turns": max(turns),
+        "avg_chars": sum(chars) / len(chars),
+        "total_mb": sum(chars) / 1e6,
+    }
+
+
+def save_conversations_with_size_limit(
+    conversations: Iterable[Dict],
+    output_dir: str,
+    base_name: str = "conversations",
+    max_mb_per_file: float = 100.0,
+) -> List[str]:
+    """Shard jsonl writer (ref :203): rotates files at the size limit."""
+    out = Path(output_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    limit = max_mb_per_file * 1e6
+    paths: List[str] = []
+    f = None
+    written = 0
+    try:
+        for conv in conversations:
+            if f is None or written > limit:
+                if f is not None:
+                    f.close()
+                path = out / f"{base_name}_{len(paths):04d}.jsonl"
+                paths.append(str(path))
+                f = open(path, "w")
+                written = 0
+            line = json.dumps(conv, ensure_ascii=False) + "\n"
+            f.write(line)
+            written += len(line.encode("utf-8"))
+    finally:
+        if f is not None:
+            f.close()
+    return paths
+
+
+# ---------------------------------------------------------------------------
+# Network edge (gated)
+# ---------------------------------------------------------------------------
+def network_available(timeout: float = 2.0) -> bool:
+    """Cheap reachability probe; False in air-gapped pods (this image)."""
+    try:
+        socket.create_connection(("8.8.8.8", 53), timeout=timeout).close()
+        return True
+    except OSError:
+        return False
+
+
+def fetch_raw(
+    url: str, dest: str, timeout: float = 60.0,
+    _opener: Optional[Callable] = None,
+) -> Optional[str]:
+    """Download url → dest; None (with guidance logged) when unreachable.
+
+    `_opener` is injectable for tests; defaults to urllib.
+    """
+    opener = _opener or (
+        lambda u: urllib.request.urlopen(u, timeout=timeout)
+    )
+    # Stream to a .part sidecar and rename on success, so a failed re-fetch
+    # can never clobber (or delete) an earlier good download at dest.
+    part = dest + ".part"
+    try:
+        with opener(url) as resp, open(part, "wb") as f:
+            while True:
+                chunk = resp.read(1 << 20)
+                if not chunk:
+                    break
+                f.write(chunk)
+        os.replace(part, dest)
+        return dest
+    except Exception as e:
+        logger.error("download failed for %s: %s", url, e)
+        logger.info(
+            "offline? process a local dump instead: "
+            "DatasetDownloader.process_local_dump(path)"
+        )
+        try:
+            os.unlink(part)
+        except OSError:
+            pass
+        return None
+
+
+class DatasetDownloader:
+    """OASST acquisition pipeline (ref :278 download_and_process).
+
+    download_and_process(): fetch via `datasets` when the environment has
+    network; otherwise returns False with guidance. process_messages():
+    the offline core — raw message rows → filtered chat-format shards.
+    """
+
+    def __init__(self, output_dir: str, max_mb_per_file: float = 100.0):
+        self.output_dir = Path(output_dir)
+        self.max_mb_per_file = max_mb_per_file
+
+    def process_messages(
+        self, messages: List[Dict], split_name: str = "train",
+        strict: bool = False,
+    ) -> Dict[str, Any]:
+        """Raw OASST rows → quality-filtered chat jsonl shards + stats."""
+        message_map, roots = build_conversation_tree(messages)
+        raw_paths: List[List[Dict]] = []
+        for root in roots:
+            raw_paths.extend(extract_conversation_paths(message_map, root))
+        formatted = [format_conversation(p) for p in raw_paths]
+        kept = filter_quality_conversations(formatted, strict=strict)
+        chat = [oasst_to_chat_format(c) for c in kept]
+        files = save_conversations_with_size_limit(
+            chat, str(self.output_dir), base_name=split_name,
+            max_mb_per_file=self.max_mb_per_file,
+        )
+        stats = analyze_conversations(kept, split_name)
+        stats["files"] = files
+        logger.info("%s: %d paths -> %d kept -> %d files",
+                    split_name, len(raw_paths), len(kept), len(files))
+        return stats
+
+    def process_local_dump(
+        self, dump_path: str, split_name: str = "train", strict: bool = False
+    ) -> Dict[str, Any]:
+        """Offline entry: a local jsonl of raw OASST message rows."""
+        messages = []
+        with open(dump_path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    messages.append(json.loads(line))
+        return self.process_messages(messages, split_name, strict)
+
+    def download_and_process(
+        self, dataset_name: str = OASST_DATASET, strict: bool = False
+    ) -> bool:
+        """Network path (ref :278): huggingface `datasets` load → process.
+        Returns False (never raises) when the environment is offline."""
+        if not network_available():
+            logger.error(
+                "no network route: cannot download %s. Use "
+                "process_local_dump() on a pre-fetched dump.", dataset_name,
+            )
+            return False
+        try:
+            from datasets import load_dataset  # optional dependency
+
+            ds = load_dataset(dataset_name)
+        except Exception as e:
+            logger.error("failed to load %s: %s", dataset_name, e)
+            return False
+        for split in ("train", "validation"):
+            if split not in ds:
+                continue
+            self.process_messages(list(ds[split]), split, strict)
+        return True
+
+
+def fetch_source(
+    source: str, output_dir: str, _opener: Optional[Callable] = None, **params
+) -> Optional[str]:
+    """Fetch one multi-source corpus dump (ref multi_source_dataset.py
+    *Processor.download_* methods). Returns the local path or None offline."""
+    if source not in SOURCE_URLS:
+        raise ValueError(
+            f"unknown source {source!r}; known: {sorted(SOURCE_URLS)}"
+        )
+    defaults = {
+        "lang": "simplewiki", "book_id": "1342", "category": "cs.LG",
+        "max_results": 100, "tag": "python", "page_size": 100,
+    }
+    defaults.update(params)
+    url = SOURCE_URLS[source].format(**defaults)
+    dest = str(Path(output_dir) / f"{source}_raw.dat")
+    Path(output_dir).mkdir(parents=True, exist_ok=True)
+    return fetch_raw(url, dest, _opener=_opener)
