@@ -195,7 +195,7 @@ def save_conversations_with_size_limit(
                     f.close()
                 path = out / f"{base_name}_{len(paths):04d}.jsonl"
                 paths.append(str(path))
-                f = open(path, "w")
+                f = open(path, "w", encoding="utf-8")
                 written = 0
             line = json.dumps(conv, ensure_ascii=False) + "\n"
             f.write(line)
@@ -291,14 +291,14 @@ class DatasetDownloader:
     def process_local_dump(
         self, dump_path: str, split_name: str = "train", strict: bool = False
     ) -> Dict[str, Any]:
-        """Offline entry: a local jsonl of raw OASST message rows."""
-        messages = []
-        with open(dump_path) as f:
-            for line in f:
-                line = line.strip()
-                if line:
-                    messages.append(json.loads(line))
-        return self.process_messages(messages, split_name, strict)
+        """Offline entry: a local jsonl of raw OASST message rows. Corrupt
+        lines are skipped with a warning (read_jsonl), not fatal — dumps
+        from interrupted downloads commonly have a truncated tail."""
+        from luminaai_tpu.data.dataset import read_jsonl
+
+        return self.process_messages(
+            list(read_jsonl(dump_path)), split_name, strict
+        )
 
     def download_and_process(
         self, dataset_name: str = OASST_DATASET, strict: bool = False
@@ -306,11 +306,12 @@ class DatasetDownloader:
         """Network path (ref :278): huggingface `datasets` load → process.
         Returns False (never raises) when the environment is offline."""
         if not network_available():
-            logger.error(
-                "no network route: cannot download %s. Use "
-                "process_local_dump() on a pre-fetched dump.", dataset_name,
+            # Advisory only: proxied environments can fail the raw TCP probe
+            # while HTTPS egress works — let load_dataset decide.
+            logger.warning(
+                "network probe failed; attempting download of %s anyway "
+                "(process_local_dump() is the offline path)", dataset_name,
             )
-            return False
         try:
             from datasets import load_dataset  # optional dependency
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import queue
 import threading
 from pathlib import Path
@@ -117,6 +118,65 @@ def read_jsonl(path: str, max_records: Optional[int] = None) -> Iterator[Dict]:
                 logger.warning("%s:%d bad json skipped", path, i + 1)
 
 
+class JsonlIndex:
+    """mmap-backed random access to jsonl records.
+
+    The native newline scanner (native.index_lines, C memchr off the GIL)
+    builds a byte-offset table once; record(i) then seeks and parses one
+    line, so multi-GB corpora support shuffled access at O(1) memory —
+    the piece the reference delegated to Arrow's memory-mapped tables
+    (ref core/dataset.py FastStreamingBaseTrainingDataset role).
+    """
+
+    def __init__(self, path: str):
+        import mmap
+
+        self.path = path
+        self._f = open(path, "rb")
+        size = os.fstat(self._f.fileno()).st_size
+        self._mm = (
+            mmap.mmap(self._f.fileno(), 0, access=mmap.ACCESS_READ)
+            if size else b""
+        )
+        from luminaai_tpu.native import index_lines
+
+        self.starts = index_lines(self._mm)
+        self._size = size
+
+    def __len__(self) -> int:
+        return len(self.starts)
+
+    def raw(self, i: int) -> bytes:
+        beg = int(self.starts[i])
+        end = (
+            int(self.starts[i + 1]) if i + 1 < len(self.starts) else self._size
+        )
+        return self._mm[beg:end]
+
+    def record(self, i: int) -> Optional[Dict]:
+        line = self.raw(i).strip()
+        if not line:
+            return None
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            logger.warning("%s: bad json at record %d skipped", self.path, i)
+            return None
+
+    def iter_shuffled(self, seed: int) -> Iterator[Dict]:
+        from luminaai_tpu.native import shuffle_indices
+
+        for i in shuffle_indices(len(self.starts), seed):
+            rec = self.record(int(i))
+            if rec is not None:
+                yield rec
+
+    def close(self) -> None:
+        if self._mm:
+            self._mm.close()
+        self._f.close()
+
+
 class ConversationDataset:
     """jsonl conversations → fixed-length tokenized samples w/ loss weights
     (ref FastConversationDataset, core/dataset.py:337).
@@ -167,9 +227,28 @@ class ConversationDataset:
     def __getitem__(self, idx: int) -> Dict[str, np.ndarray]:
         return self.samples[idx]
 
-    def iter_samples(self) -> Iterator[Dict[str, np.ndarray]]:
+    def iter_samples(
+        self, shuffle_seed: Optional[int] = None
+    ) -> Iterator[Dict[str, np.ndarray]]:
         if not self.streaming:
             yield from self.samples
+            return
+        if shuffle_seed is not None:
+            # Shuffled streaming: mmap + native newline index gives O(1)-
+            # memory random access instead of sequential-only epochs.
+            index = JsonlIndex(self.path)
+            try:
+                convs: Iterator[Dict] = index.iter_shuffled(shuffle_seed)
+                for conv in convs:
+                    enc = self.tokenizer.encode_conversation(
+                        conv,
+                        max_length=self.config.seq_length,
+                        pad_to_length=self.config.seq_length,
+                    )
+                    if enc is not None:
+                        yield enc
+            finally:
+                index.close()
             return
         for conv in read_jsonl(self.path):
             enc = self.tokenizer.encode_conversation(
@@ -376,7 +455,8 @@ def conversation_batches(
     """Group per-conversation samples into [B, S] batches."""
     if dataset.streaming:
         buf: List[Dict[str, np.ndarray]] = []
-        for s in dataset.iter_samples():
+        # Streaming epochs shuffle too, via the mmap'd line index.
+        for s in dataset.iter_samples(shuffle_seed=seed):
             buf.append(s)
             if len(buf) == batch_size:
                 yield _stack(buf)

@@ -137,12 +137,46 @@ class SourceProcessor:
         self.spec = SOURCES[source]
 
     def iter_clean(
-        self, inputs: Sequence[str], text_key: str = "text"
+        self, inputs: Sequence[str], text_key: str = "text",
+        dedup: bool = False, dedup_chunk: int = 512,
+    ) -> Iterator[Dict[str, Any]]:
+        """Cleaned records; dedup=True drops exact duplicates by 64-bit
+        content hash (web corpora like CC-News and OpenWebText repeat
+        articles across dumps). Hashing batches `dedup_chunk` texts per
+        native FNV-1a call so per-call ctypes overhead amortizes."""
+        if not dedup:
+            yield from self._iter_raw(inputs, text_key)
+            return
+
+        from luminaai_tpu.native import content_hashes
+
+        seen: set = set()
+        chunk: List[str] = []
+
+        def flush():
+            if not chunk:
+                return
+            hashes = content_hashes([t.encode("utf-8") for t in chunk])
+            for text, h in zip(chunk, hashes):
+                h = int(h)
+                if h not in seen:
+                    seen.add(h)
+                    yield {"text": text, "source": self.spec.name}
+            chunk.clear()
+
+        for rec in self._iter_raw(inputs, text_key):
+            chunk.append(rec["text"])
+            if len(chunk) >= dedup_chunk:
+                yield from flush()
+        yield from flush()
+
+    def _iter_raw(
+        self, inputs: Sequence[str], text_key: str
     ) -> Iterator[Dict[str, Any]]:
         for path in inputs:
             p = Path(path)
             if p.suffix == ".jsonl":
-                with p.open() as f:
+                with p.open(encoding="utf-8", errors="replace") as f:
                     for line in f:
                         try:
                             rec = json.loads(line)
@@ -164,8 +198,10 @@ class SourceProcessor:
         num_files: int = 1,
         mb_per_file: float = 50.0,
         text_key: str = "text",
+        dedup: bool = False,
     ) -> List[str]:
-        """Write cleaned jsonl shards, size-capped (ref :457 etc.)."""
+        """Write cleaned jsonl shards, size-capped (ref :457 etc.);
+        dedup drops exact duplicates across the inputs (iter_clean)."""
         out_dir = Path(output_dir)
         out_dir.mkdir(parents=True, exist_ok=True)
         limit = int(mb_per_file * 1e6)
@@ -174,7 +210,7 @@ class SourceProcessor:
         written = 0
         idx = 0
         try:
-            for rec in self.iter_clean(inputs, text_key):
+            for rec in self.iter_clean(inputs, text_key, dedup=dedup):
                 if f is None or written >= limit:
                     if f:
                         f.close()
@@ -182,7 +218,7 @@ class SourceProcessor:
                         break
                     path = out_dir / f"{self.spec.name}_{idx:04d}.jsonl"
                     paths.append(str(path))
-                    f = path.open("w")
+                    f = path.open("w", encoding="utf-8")
                     written = 0
                     idx += 1
                 line = json.dumps(rec) + "\n"

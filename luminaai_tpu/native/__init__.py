@@ -71,6 +71,16 @@ def _build() -> Optional[ctypes.CDLL]:
     lib.lumina_shuffle_indices.argtypes = [
         ctypes.POINTER(ctypes.c_int64), ctypes.c_long, ctypes.c_uint64
     ]
+    lib.lumina_index_lines.restype = ctypes.c_long
+    lib.lumina_index_lines.argtypes = [
+        ctypes.c_char_p, ctypes.c_long,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_long,
+    ]
+    lib.lumina_fnv1a64_batch.restype = None
+    lib.lumina_fnv1a64_batch.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_long, ctypes.POINTER(ctypes.c_uint64),
+    ]
     return lib
 
 
@@ -186,3 +196,60 @@ def shuffle_indices(n: int, seed: int, use_native: bool = True) -> np.ndarray:
     rng = np.random.RandomState(seed & 0x7FFFFFFF)
     rng.shuffle(idx)
     return idx
+
+
+def index_lines(data, use_native: bool = True) -> np.ndarray:
+    """Byte offsets of every line start in a buffer (jsonl random access).
+
+    `data` is any buffer (bytes / mmap / memoryview); indexing is zero-copy
+    via numpy's buffer view. The C scanner runs memchr over the buffer off
+    the GIL; fallback is a numpy newline scan (bit-identical, tested).
+    """
+    arr = np.frombuffer(data, dtype=np.uint8)
+    n_bytes = arr.size
+    if n_bytes == 0:
+        return np.empty(0, dtype=np.int64)
+    lib = get_lib() if use_native else None
+    if lib is not None:
+        # Seed capacity from the buffer size so the first memchr pass
+        # almost always suffices (retry re-scans the whole buffer).
+        cap = max(4096, n_bytes // 32)
+        while True:
+            out = np.empty(cap, dtype=np.int64)
+            n = lib.lumina_index_lines(
+                arr.ctypes.data_as(ctypes.c_char_p), n_bytes,
+                _as_c(out, ctypes.c_int64), cap,
+            )
+            if n >= 0:
+                return out[:n].copy()
+            cap = -n
+    newlines = np.flatnonzero(arr == ord("\n"))
+    starts = np.concatenate([[0], newlines + 1])
+    if starts[-1] >= n_bytes:  # trailing newline: no final line start
+        starts = starts[:-1]
+    return starts.astype(np.int64)
+
+
+def content_hashes(
+    docs: "list[bytes]", use_native: bool = True
+) -> np.ndarray:
+    """FNV-1a 64-bit hash per document (dedup keys for the multi-source
+    blender). Native path hashes one concatenated buffer off the GIL."""
+    n = len(docs)
+    out = np.empty(n, dtype=np.uint64)
+    lib = get_lib() if use_native else None
+    if lib is not None and n:
+        buf = b"".join(docs)
+        offsets = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum([len(d) for d in docs], out=offsets[1:])
+        lib.lumina_fnv1a64_batch(
+            buf, _as_c(offsets, ctypes.c_int64), n,
+            _as_c(out, ctypes.c_uint64),
+        )
+        return out
+    for i, d in enumerate(docs):
+        h = np.uint64(14695981039346656037)
+        for b in d:
+            h = np.uint64((int(h) ^ b) * 1099511628211 & 0xFFFFFFFFFFFFFFFF)
+        out[i] = h
+    return out

@@ -95,6 +95,45 @@ long lumina_pack_batch(
     return doc;
 }
 
+// Newline indexer for jsonl corpora: scans a byte buffer and writes the
+// byte offset of each line start into out (capacity max_lines). Returns the
+// number of line starts found, or -(needed) when capacity is too small so
+// the caller can retry with an exact allocation. Lets the streaming dataset
+// seek to record i of a multi-GB jsonl without a Python-side scan.
+long lumina_index_lines(
+    const char* buf, long n_bytes, int64_t* out, long max_lines
+) {
+    if (!buf || n_bytes < 0) return -1;
+    long count = 0;
+    long pos = 0;
+    while (pos < n_bytes) {
+        if (count < max_lines && out) out[count] = pos;
+        ++count;
+        const char* nl = static_cast<const char*>(
+            memchr(buf + pos, '\n', static_cast<size_t>(n_bytes - pos)));
+        if (!nl) break;
+        pos = static_cast<long>(nl - buf) + 1;
+    }
+    if (count > max_lines) return -count;
+    return count;
+}
+
+// FNV-1a 64-bit content hashes for document deduplication (the multi-source
+// blender's dedup stage). One hash per [offsets[i], offsets[i+1]) slice.
+void lumina_fnv1a64_batch(
+    const char* buf, const int64_t* offsets, long n_docs, uint64_t* out
+) {
+    if (!buf || !offsets || !out) return;
+    for (long d = 0; d < n_docs; ++d) {
+        uint64_t h = 14695981039346656037ULL;
+        for (int64_t i = offsets[d]; i < offsets[d + 1]; ++i) {
+            h ^= static_cast<uint8_t>(buf[i]);
+            h *= 1099511628211ULL;
+        }
+        out[d] = h;
+    }
+}
+
 // Simple xorshift shuffle of an index array (deterministic per seed) so the
 // epoch permutation can also live off the GIL for very large datasets.
 void lumina_shuffle_indices(int64_t* idx, long n, uint64_t seed) {
