@@ -125,8 +125,16 @@ def make_data(cfg, args):
     if not ds.streaming:
         tokens = sum(int(s["loss_mask"].size) for s in ds.samples)
 
+    epoch_counter = {"n": 0}
+
     def train_fn():
-        return conversation_batches(ds, cfg.batch_size, seed=cfg.seed)
+        # Fresh permutation per epoch (the trainer re-invokes this callable
+        # at each epoch boundary; a constant seed would replay identical
+        # batch order every epoch).
+        epoch_counter["n"] += 1
+        return conversation_batches(
+            ds, cfg.batch_size, seed=cfg.seed + epoch_counter["n"]
+        )
 
     eval_fn = None
     if getattr(args, "eval_data", None):
@@ -171,9 +179,12 @@ def cmd_train(args) -> int:
     trainer = Trainer(cfg, train_data=train_fn, eval_data=eval_fn)
     _install_signal_handlers(trainer)
 
+    oom_protect = getattr(args, "oom_protect", True)
     if args.adaptive:
         orchestrator = AdaptiveTrainingOrchestrator(trainer)
-        summary = orchestrator.run()
+        summary = orchestrator.run(oom_protect=oom_protect)
+    elif oom_protect:
+        summary = trainer.train_with_oom_protection()
     else:
         summary = trainer.train()
     trainer.close()
@@ -193,7 +204,18 @@ def cmd_train(args) -> int:
 def cmd_chat(args) -> int:
     from luminaai_tpu.inference.chat import ChatInterface
 
-    chat = ChatInterface(checkpoint_dir=args.checkpoint)
+    chat = ChatInterface(
+        checkpoint_dir=args.checkpoint,
+        quantize=getattr(args, "quantize", None),
+    )
+    if chat.engine.quantization_info:
+        q = chat.engine.quantization_info
+        print(
+            f"serving with int{q['bits']} weight round-trip: "
+            f"{q['quantized_leaves']} tensors, {q['compression']:.2f}x "
+            "smaller at rest (resident serving copy stays bf16 for MXU "
+            "compute)", file=sys.stderr,
+        )
     # Generation defaults live on the engine's config (ref Chat.py mode
     # presets); CLI flags override them for the session.
     chat.engine.config.temperature = args.temperature
@@ -272,6 +294,21 @@ def cmd_data(args) -> int:
     if args.action == "sample":
         n = create_sample_data(args.out, num_conversations=args.count)
         print(f"wrote {n} sample conversations to {args.out}")
+    elif args.action == "acquire":
+        from luminaai_tpu.data.acquisition import DatasetDownloader
+
+        dl = DatasetDownloader(args.out or "data/oasst")
+        if args.inp:  # offline path: local raw OASST dump
+            stats = dl.process_local_dump(args.inp)
+            print(json.dumps(_jsonable(stats), indent=2))
+        else:
+            ok = dl.download_and_process()
+            if not ok:
+                print(
+                    "download unavailable (offline?); pass --in DUMP.jsonl "
+                    "to process a local raw dump", file=sys.stderr,
+                )
+                return 1
     elif args.action == "oasst":
         n = process_oasst_data(args.inp, args.out)
         print(f"converted {n} conversations -> {args.out}")
@@ -282,6 +319,34 @@ def cmd_data(args) -> int:
             args.inp, ConversationTokenizer()
         )
         print(json.dumps(_jsonable(report), indent=2))
+    return 0
+
+
+def cmd_report(args) -> int:
+    """HTML reports (ref utils/reporting.py)."""
+    if args.kind == "training":
+        from luminaai_tpu.utils.reporting import create_training_report
+
+        if not args.dir:
+            print("report training requires --dir EXPERIMENT_DIR",
+                  file=sys.stderr)
+            return 2
+        out = create_training_report(args.dir, args.out)
+        if out is None:
+            print(
+                f"no training_summary.json under {args.dir}", file=sys.stderr
+            )
+            return 1
+        print(f"training report: {out}")
+    else:
+        from luminaai_tpu.data.tokenizer import ConversationTokenizer
+        from luminaai_tpu.utils.reporting import create_data_summary_report
+
+        out = create_data_summary_report(
+            args.inputs, ConversationTokenizer(),
+            output_path=args.out or "data_summary_report.html",
+        )
+        print(f"data report: {out}")
     return 0
 
 
@@ -405,6 +470,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="chinchilla-style step budget from dataset size")
     t.add_argument("--resume", action="store_true")
     t.add_argument("--quiet", action="store_true")
+    t.add_argument("--oom-protect", dest="oom_protect",
+                   action=argparse.BooleanOptionalAction, default=True,
+                   help="backoff ladder on device OOM (microbatch split, "
+                        "then batch halving)")
     t.set_defaults(fn=cmd_train)
 
     r = sub.add_parser("resume", help="resume training from output dir")
@@ -417,6 +486,8 @@ def build_parser() -> argparse.ArgumentParser:
                    default=True)
     r.add_argument("--auto-epochs", action="store_true")
     r.add_argument("--quiet", action="store_true")
+    r.add_argument("--oom-protect", dest="oom_protect",
+                   action=argparse.BooleanOptionalAction, default=True)
     r.set_defaults(fn=cmd_train, resume=True)
     t.set_defaults(resume=False)
 
@@ -432,6 +503,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="require auth; rate-limit and validate inputs")
     c.add_argument("--user")
     c.add_argument("--password")
+    c.add_argument("--quantize", choices=["int8", "int4"],
+                   help="weight-only quantization for serving")
     c.set_defaults(fn=cmd_chat)
 
     b = sub.add_parser("benchmark", help="run the bench harness")
@@ -440,11 +513,18 @@ def build_parser() -> argparse.ArgumentParser:
     b.set_defaults(fn=cmd_benchmark)
 
     d = sub.add_parser("data", help="dataset utilities")
-    d.add_argument("action", choices=["sample", "oasst", "validate"])
+    d.add_argument("action", choices=["sample", "oasst", "validate", "acquire"])
     d.add_argument("--in", dest="inp")
     d.add_argument("--out")
     d.add_argument("--count", type=int, default=100)
     d.set_defaults(fn=cmd_data)
+
+    rp = sub.add_parser("report", help="HTML reports")
+    rp.add_argument("kind", choices=["training", "data"])
+    rp.add_argument("--dir", help="experiment dir (training report)")
+    rp.add_argument("--out")
+    rp.add_argument("inputs", nargs="*", help="jsonl files (data report)")
+    rp.set_defaults(fn=cmd_report)
 
     g = sub.add_parser("diagnose", help="system diagnostics")
     g.add_argument("--preset", help="also check whether PRESET fits")

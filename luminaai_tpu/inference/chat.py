@@ -143,6 +143,7 @@ class ChatInterface:
         config: Optional[Config] = None,
         tokenizer: Optional[ConversationTokenizer] = None,
         engine: Optional[GenerationEngine] = None,
+        quantize: Optional[str] = None,
     ):
         if engine is not None:
             self.engine = engine
@@ -159,6 +160,10 @@ class ChatInterface:
             model, params, config = load_model_for_inference(
                 checkpoint_dir, config=config
             )
+            if quantize is not None:
+                # Serve int8/int4 weight-only (the engine applies it from
+                # config; ref trainer.py:575 QuantizationManager).
+                config.quantization_method = quantize
             self.config = config
             tokenizer = tokenizer or ConversationTokenizer(
                 model_name=config.tokenizer_name

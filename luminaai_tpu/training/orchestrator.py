@@ -531,13 +531,21 @@ class AdaptiveTrainingOrchestrator:
         )
 
     # -- wiring -----------------------------------------------------------
-    def run(self) -> Dict[str, Any]:
-        """Train under adaptive control; returns trainer summary + decisions."""
+    def run(self, oom_protect: bool = True) -> Dict[str, Any]:
+        """Train under adaptive control; returns trainer summary + decisions.
+
+        oom_protect wraps the loop in the trainer's backoff ladder (ref
+        Main.py:292 wrap_orchestrator_with_oom_protection).
+        """
         suggestion = self.meta.suggest_hyperparameters(self.config)
         if suggestion:
             logger.info("meta-learning suggestion (informational): %s", suggestion)
         self.trainer.step_callback = self.on_metrics
-        summary = self.trainer.train()
+        summary = (
+            self.trainer.train_with_oom_protection()
+            if oom_protect
+            else self.trainer.train()
+        )
         self.meta.record_training_outcome(
             self.config, summary.get("final_metrics", {})
         )

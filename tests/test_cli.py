@@ -120,3 +120,46 @@ def test_config_file_roundtrip(tmp_path):
     )
     loaded = build_config(args)
     assert abs(loaded.learning_rate - 3.21e-4) < 1e-12
+
+
+def test_data_acquire_local_dump(tmp_path, capsys):
+    dump = tmp_path / "raw.jsonl"
+    rows = [
+        {"message_id": "r", "parent_id": None, "role": "prompter",
+         "text": "hello there, what is jax?", "lang": "en"},
+        {"message_id": "a", "parent_id": "r", "role": "assistant",
+         "text": "JAX is a numerical computing library with autodiff.",
+         "lang": "en"},
+    ]
+    with open(dump, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    out_dir = tmp_path / "out"
+    assert run_cli([
+        "data", "acquire", "--in", str(dump), "--out", str(out_dir)
+    ]) == 0
+    stats = json.loads(capsys.readouterr().out)
+    assert stats["count"] == 1 and stats["files"]
+
+
+def test_report_training_and_data(tmp_path, capsys):
+    exp = tmp_path / "exp"
+    exp.mkdir()
+    (exp / "training_summary.json").write_text(json.dumps({
+        "experiment_name": "cli-test", "total_steps": 5,
+        "final_metrics": {"best_eval_loss": 3.0},
+    }))
+    assert run_cli(["report", "training", "--dir", str(exp)]) == 0
+    assert "training report" in capsys.readouterr().out
+
+    data = tmp_path / "d.jsonl"
+    data.write_text(json.dumps({"messages": [
+        {"role": "user", "content": "hi"},
+        {"role": "assistant", "content": "hello!"},
+    ]}) + "\n")
+    out = tmp_path / "data_report.html"
+    assert run_cli(["report", "data", "--out", str(out), str(data)]) == 0
+    assert out.exists()
+
+    # training report on a dir without a summary fails cleanly
+    assert run_cli(["report", "training", "--dir", str(tmp_path / "nope")]) == 1
