@@ -110,3 +110,15 @@ def test_model_sp_with_tp_and_fsdp():
     )
     _, metrics, _ = run_one_step(cfg)
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_ring_composes_with_scan_and_remat():
+    """The perf-critical combination: scan_layers + ring attention + remat
+    + fused CE in one train step, loss parity with the plain path."""
+    cfg = tiny_config(
+        sequence_parallel_size=2, use_ring_attention=True, scan_layers=True,
+        gradient_checkpointing=True, num_layers=4,
+    )
+    _, m, _ = run_one_step(cfg)
+    _, m2, _ = run_one_step(tiny_config(num_layers=4))
+    assert abs(float(m["ce_loss"]) - float(m2["ce_loss"])) < 5e-2
