@@ -150,6 +150,11 @@ class Config:
     # --- Production / experiment ---
     experiment_name: Optional[str] = None
     output_dir: str = "experiments"
+    # Capture a jax.profiler device trace (TensorBoard XPlane) for steps
+    # [profile_start_step, profile_start_step + profile_num_steps) into
+    # output_dir/profile. 0 disables (SURVEY §5 tracing).
+    profile_start_step: int = 0
+    profile_num_steps: int = 3
     seed: int = 42
     log_level: str = "INFO"
     save_total_limit: int = 5

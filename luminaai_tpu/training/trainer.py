@@ -508,6 +508,7 @@ class Trainer:
                 if self.global_step >= self.total_steps:
                     break
                 first_step = self.global_step == self._run_start_step
+                self._maybe_profile()
                 self.state, metrics = self.train_step(self.state, batch)
                 self.global_step += 1
                 n_tok = int(batch["input_ids"].size)
@@ -592,6 +593,31 @@ class Trainer:
         logger.info("training done: %s", summary)
         return summary
 
+    # -- profiling (SURVEY §5 tracing) -------------------------------------
+    def _maybe_profile(self) -> None:
+        """Start/stop a jax.profiler device trace around the configured
+        step window (config.profile_start_step / profile_num_steps)."""
+        cfg = self.config
+        if not cfg.profile_start_step:
+            return
+        if self.global_step == cfg.profile_start_step:
+            trace_dir = f"{cfg.output_dir}/profile"
+            try:
+                jax.profiler.start_trace(trace_dir)
+                self._profiling = True
+                logger.info("profiler trace started -> %s", trace_dir)
+            except Exception as e:  # already tracing / unsupported backend
+                logger.warning("profiler start failed: %s", e)
+                self._profiling = False
+        elif (
+            getattr(self, "_profiling", False)
+            and self.global_step >= cfg.profile_start_step + cfg.profile_num_steps
+        ):
+            jax.block_until_ready(self.state.params)
+            jax.profiler.stop_trace()
+            self._profiling = False
+            logger.info("profiler trace stopped")
+
     # -- failure handling --------------------------------------------------
     def _handle_nonfinite(self) -> bool:
         """NaN/Inf loss: rollback strictly before first detection, else abort
@@ -641,4 +667,10 @@ class Trainer:
         return False
 
     def close(self) -> None:
+        if getattr(self, "_profiling", False):  # run ended inside the window
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._profiling = False
         self.checkpoints.close()

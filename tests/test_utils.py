@@ -107,3 +107,19 @@ def test_data_summary_report(tmp_path):
     )
     html = Path(out).read_text()
     assert "data.jsonl" in html and "Issue Breakdown" in html
+
+
+def test_trainer_profile_window(tmp_path):
+    """config.profile_start_step captures a device trace mid-run."""
+    from luminaai_tpu.training.trainer import Trainer
+    from tests.test_orchestrator import patterned_data, tiny_config
+
+    cfg = tiny_config(
+        tmp_path, max_steps=6, profile_start_step=2, profile_num_steps=2,
+    )
+    t = Trainer(cfg, train_data=patterned_data(cfg),
+                checkpoint_dir=str(tmp_path / "ckpt"))
+    t.train()
+    t.close()
+    profile_dir = Path(cfg.output_dir) / "profile"
+    assert profile_dir.exists() and any(profile_dir.rglob("*"))
