@@ -99,12 +99,19 @@ def make_data(cfg, args):
     )
     from luminaai_tpu.data.tokenizer import ConversationTokenizer
 
-    if getattr(args, "synthetic", False) or not getattr(args, "data", None):
+    # --data wins; config train_data_path is a fallback only when the file
+    # actually exists (its default 'data/train.jsonl' must not shadow the
+    # synthetic-data default on fresh checkouts).
+    cfg_path = cfg.train_data_path
+    data_path = getattr(args, "data", None) or (
+        cfg_path if cfg_path and Path(cfg_path).exists() else None
+    )
+    if getattr(args, "synthetic", False) or not data_path:
         if not getattr(args, "synthetic", False):
             logger.warning("no --data given; training on synthetic data")
         return _synthetic_batches(cfg), None, None
 
-    path = args.data
+    path = data_path
     tokenizer = ConversationTokenizer(
         assistant_loss_weight=cfg.assistant_loss_weight
     )
@@ -117,8 +124,13 @@ def make_data(cfg, args):
             cache, cfg.batch_size, cfg.seq_length,
             pad_id=tokenizer.pad_token_id, eos_id=tokenizer.eos_token_id,
             shuffle_seed=cfg.seed,
+            use_native=cfg.use_native_dataloader,
+            split_docs=cfg.pack_sequences,
         )
-        return PrefetchLoader(lambda: iter(ds)), None, cache.n_tokens
+        return (
+            PrefetchLoader(lambda: iter(ds), prefetch=max(1, cfg.num_workers)),
+            None, cache.n_tokens,
+        )
 
     ds = ConversationDataset(path, tokenizer, cfg)
     tokens = None
@@ -137,13 +149,21 @@ def make_data(cfg, args):
         )
 
     eval_fn = None
-    if getattr(args, "eval_data", None):
-        eval_ds = ConversationDataset(args.eval_data, tokenizer, cfg, split="eval")
+    eval_path = getattr(args, "eval_data", None) or (
+        cfg.eval_data_path
+        if cfg.eval_data_path and Path(cfg.eval_data_path).exists()
+        else None
+    )
+    if eval_path:
+        eval_ds = ConversationDataset(eval_path, tokenizer, cfg, split="eval")
 
         def eval_fn():
             return conversation_batches(eval_ds, cfg.batch_size, seed=0)
 
-    return PrefetchLoader(train_fn), eval_fn, tokens
+    return (
+        PrefetchLoader(train_fn, prefetch=max(1, cfg.num_workers)),
+        eval_fn, tokens,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -159,11 +179,13 @@ def cmd_train(args) -> int:
         print(format_diagnostics())
 
     cfg = build_config(args)
+    logging.getLogger().setLevel(cfg.log_level)
     if args.resume:
         cfg.auto_resume = True
     train_fn, eval_fn, dataset_tokens = make_data(cfg, args)
 
-    if args.auto_epochs and dataset_tokens:
+    auto_epochs = args.auto_epochs or cfg.use_chinchilla_scaling
+    if auto_epochs and dataset_tokens:
         # Chinchilla budget → step count (ref Main.py:1404
         # auto_adjust_epochs_chinchilla). An explicit --steps wins: the
         # budget is advice, not an override of the operator.
@@ -295,9 +317,16 @@ def cmd_data(args) -> int:
         n = create_sample_data(args.out, num_conversations=args.count)
         print(f"wrote {n} sample conversations to {args.out}")
     elif args.action == "acquire":
+        from luminaai_tpu.config import Config
         from luminaai_tpu.data.acquisition import DatasetDownloader
 
-        dl = DatasetDownloader(args.out or "data/oasst")
+        max_per_file = args.max_per_file
+        if max_per_file is None:  # flag overrides the config default
+            max_per_file = Config().max_conversations_per_file
+        dl = DatasetDownloader(
+            args.out or "data/oasst",
+            max_records_per_file=max_per_file,
+        )
         if args.inp:  # offline path: local raw OASST dump
             stats = dl.process_local_dump(args.inp)
             print(json.dumps(_jsonable(stats), indent=2))
@@ -517,6 +546,10 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--in", dest="inp")
     d.add_argument("--out")
     d.add_argument("--count", type=int, default=100)
+    d.add_argument("--max-per-file", dest="max_per_file", type=int,
+                   default=None,
+                   help="acquire: rotate output shards after N conversations "
+                        "(config.max_conversations_per_file equivalent)")
     d.set_defaults(fn=cmd_data)
 
     rp = sub.add_parser("report", help="HTML reports")

@@ -208,6 +208,19 @@ class Config:
             self.micro_batch_size = max(
                 1, self.batch_size // max(1, self.gradient_accumulation_steps)
             )
+        elif (
+            self.gradient_accumulation_steps == 1
+            and 0 < self.micro_batch_size < self.batch_size
+        ):
+            # Explicit micro_batch_size drives the in-jit accumulation
+            # split (the reference's dataloader-batch knob, ref
+            # config_manager.py micro_batch_size).
+            assert self.batch_size % self.micro_batch_size == 0, (
+                "batch_size must be a multiple of micro_batch_size"
+            )
+            self.gradient_accumulation_steps = (
+                self.batch_size // self.micro_batch_size
+            )
         if isinstance(self.mesh_axes, list):
             self.mesh_axes = tuple(self.mesh_axes)
         self.validate()

@@ -180,26 +180,33 @@ def save_conversations_with_size_limit(
     output_dir: str,
     base_name: str = "conversations",
     max_mb_per_file: float = 100.0,
+    max_records_per_file: Optional[int] = None,
 ) -> List[str]:
-    """Shard jsonl writer (ref :203): rotates files at the size limit."""
+    """Shard jsonl writer (ref :203): rotates at the size limit and/or the
+    record-count limit (config.max_conversations_per_file)."""
     out = Path(output_dir)
     out.mkdir(parents=True, exist_ok=True)
     limit = max_mb_per_file * 1e6
     paths: List[str] = []
     f = None
     written = 0
+    records = 0
     try:
         for conv in conversations:
-            if f is None or written > limit:
+            if f is None or written > limit or (
+                max_records_per_file and records >= max_records_per_file
+            ):
                 if f is not None:
                     f.close()
                 path = out / f"{base_name}_{len(paths):04d}.jsonl"
                 paths.append(str(path))
                 f = open(path, "w", encoding="utf-8")
                 written = 0
+                records = 0
             line = json.dumps(conv, ensure_ascii=False) + "\n"
             f.write(line)
             written += len(line.encode("utf-8"))
+            records += 1
     finally:
         if f is not None:
             f.close()
@@ -262,9 +269,15 @@ class DatasetDownloader:
     the offline core — raw message rows → filtered chat-format shards.
     """
 
-    def __init__(self, output_dir: str, max_mb_per_file: float = 100.0):
+    def __init__(
+        self,
+        output_dir: str,
+        max_mb_per_file: float = 100.0,
+        max_records_per_file: Optional[int] = None,
+    ):
         self.output_dir = Path(output_dir)
         self.max_mb_per_file = max_mb_per_file
+        self.max_records_per_file = max_records_per_file
 
     def process_messages(
         self, messages: List[Dict], split_name: str = "train",
@@ -281,6 +294,7 @@ class DatasetDownloader:
         files = save_conversations_with_size_limit(
             chat, str(self.output_dir), base_name=split_name,
             max_mb_per_file=self.max_mb_per_file,
+            max_records_per_file=self.max_records_per_file,
         )
         stats = analyze_conversations(kept, split_name)
         stats["files"] = files

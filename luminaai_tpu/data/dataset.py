@@ -286,6 +286,8 @@ class PackedDataset:
         pad_id: int = 0,
         eos_id: int = -1,
         shuffle_seed: Optional[int] = None,
+        use_native: bool = True,
+        split_docs: bool = True,
     ):
         if cache.tokens is None:
             cache.open()
@@ -295,6 +297,10 @@ class PackedDataset:
         self.pad_id = pad_id
         self.eos_id = eos_id
         self.shuffle_seed = shuffle_seed
+        self.use_native = use_native
+        # pack_sequences=False semantics: a document never straddles rows
+        # (truncate-to-row instead of contiguous-stream packing).
+        self.split_docs = split_docs
 
     def batches_per_epoch(self) -> int:
         per_batch = self.batch_size * self.seq_length
@@ -313,7 +319,8 @@ class PackedDataset:
                 tokens, offsets, doc,
                 self.batch_size, self.seq_length,
                 pad_id=self.pad_id, eos_id=self.eos_id,
-                split_docs=True, start_token=tok,
+                split_docs=self.split_docs, start_token=tok,
+                use_native=self.use_native,
             )
             if mask.sum() == 0:
                 break
@@ -358,7 +365,8 @@ class PackedDataset:
                 cat, local_offsets, 0,
                 self.batch_size, self.seq_length,
                 pad_id=self.pad_id, eos_id=self.eos_id,
-                split_docs=True, start_token=0,
+                split_docs=self.split_docs, start_token=0,
+                use_native=self.use_native,
             )
             if mask.sum() == 0:
                 break

@@ -130,6 +130,18 @@ def load_model_for_inference(
             except Exception:
                 logger.info("no config metadata; inferring from params")
                 config = infer_config_from_params(params)
+    # Serving precision (config.inference_precision, 'auto' → bf16):
+    # cast float weights down so the resident serving copy matches the
+    # compute dtype instead of keeping fp32 masters around.
+    if "bf16" in config.resolve_precision(for_inference=True):
+        import jax.numpy as jnp
+
+        params = jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16)
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+            else x,
+            params,
+        )
     model = LuminaTransformer(config)
     return model, params, config
 

@@ -348,6 +348,9 @@ def infer_config_from_params(params: Dict[str, Any]) -> Config:
         num_heads=n_heads,
         num_kv_heads=n_kv,
         use_moe=use_moe,
+        # Untied checkpoints carry a separate output head; missing this
+        # would silently decode with the input embeddings.
+        tie_word_embeddings="lm_head" not in params["embedder"],
     )
     if use_moe:
         moe_layers = [i for i in layers if "moe" in params[f"layer_{i}"]]

@@ -327,6 +327,16 @@ class Embedder(nn.Module):
             (cfg.vocab_size, cfg.hidden_size),
             jnp.float32,
         )
+        if not cfg.tie_word_embeddings:
+            # Untied output head (ref config tie_word_embeddings=False).
+            self.lm_head = self.param(
+                "lm_head",
+                nn.with_logical_partitioning(
+                    default_init(cfg.init_std), ("vocab", "embed")
+                ),
+                (cfg.vocab_size, cfg.hidden_size),
+                jnp.float32,
+            )
 
     def encode(self, tokens: jax.Array) -> jax.Array:
         x = jnp.take(self.embedding, tokens, axis=0).astype(self.dtype)
@@ -336,6 +346,11 @@ class Embedder(nn.Module):
 
     def decode(self, x: jax.Array) -> jax.Array:
         # fp32 logits for a numerically stable softmax/CE.
+        head = (
+            self.embedding
+            if self.config.tie_word_embeddings
+            else self.lm_head
+        )
         return jnp.einsum(
-            "bsd,vd->bsv", x.astype(jnp.float32), self.embedding.astype(jnp.float32)
+            "bsd,vd->bsv", x.astype(jnp.float32), head.astype(jnp.float32)
         )

@@ -166,7 +166,24 @@ class TrainingHealthMonitor:
         loss_spike_threshold: float = 2.0,
         grad_norm_threshold: float = 100.0,
         health_check_interval: int = 100,
+        wandb_config: Optional[Dict[str, Any]] = None,
     ):
+        # Optional Weights & Biases mirror (ref enable_wandb). Degrades to
+        # a warning when the package is absent (this image has no wandb);
+        # the jsonl log below is always the source of truth.
+        self._wandb = None
+        if wandb_config and wandb_config.get("enable"):
+            try:
+                import wandb
+
+                self._wandb = wandb.init(
+                    project=wandb_config.get("project") or "luminaai_tpu",
+                    entity=wandb_config.get("entity"),
+                    name=wandb_config.get("run_name"),
+                    config=wandb_config.get("run_config"),
+                )
+            except Exception as e:
+                logger.warning("wandb disabled (%s); jsonl logging only", e)
         self.collector = MetricsCollector(
             loss_spike_threshold=loss_spike_threshold,
             grad_norm_threshold=grad_norm_threshold,
@@ -211,6 +228,11 @@ class TrainingHealthMonitor:
         if self.log_path is not None:
             with self.log_path.open("a") as f:
                 f.write(json.dumps({"step": step, "ts": now, **scalars}) + "\n")
+        if self._wandb is not None:
+            try:
+                self._wandb.log(scalars, step=step)
+            except Exception:  # never let telemetry kill training
+                pass
 
     def _update_phase(self, step: int, metrics: Dict[str, float]) -> None:
         """Rough phase model (ref logger.py:340 _update_training_phase)."""

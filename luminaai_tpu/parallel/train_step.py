@@ -100,7 +100,10 @@ def _ce(
     plain logits path, depending on config.fused_lm_head_ce."""
     if config.fused_lm_head_ce:
         hidden = model_out
-        embedding = params["embedder"]["embedding"]
+        head_name = (
+            "embedding" if config.tie_word_embeddings else "lm_head"
+        )
+        embedding = params["embedder"][head_name]
         if isinstance(embedding, nn.meta.AxisMetadata):
             embedding = embedding.unbox()  # raw model.init trees are boxed
         return fused_lm_head_cross_entropy(

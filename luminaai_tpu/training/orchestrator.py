@@ -278,6 +278,7 @@ class RealTimeAnalytics:
             "loss_spike_min_increase": 0.1,
             "gradient_explosion_threshold": 100.0,
             "gradient_explosion_relative": 10.0,
+            "expert_collapse_threshold": 0.05,
             "min_buffer_size": 50,
             "recent_window": 10,
         }
@@ -363,7 +364,10 @@ class RealTimeAnalytics:
             })
         util = buf[-1].get("expert_utilization")
         if util is not None and util.size:
-            if util.min() < 0.01 and util.max() > 0.5 * util.size:
+            if (
+                util.min() < t["expert_collapse_threshold"]
+                and util.max() > 0.5 * util.size
+            ):
                 anomalies.append({
                     "type": "expert_collapse", "severity": "high",
                     "description": (
@@ -529,6 +533,9 @@ class AdaptiveTrainingOrchestrator:
         self.analytics.thresholds["gradient_explosion_threshold"] = (
             self.config.grad_norm_threshold
         )
+        self.analytics.thresholds["expert_collapse_threshold"] = (
+            self.config.expert_collapse_threshold
+        )
 
     # -- wiring -----------------------------------------------------------
     def run(self, oom_protect: bool = True) -> Dict[str, Any]:
@@ -615,7 +622,11 @@ class AdaptiveTrainingOrchestrator:
             step > warmup_steps
             and step < 0.9 * self.trainer.total_steps
         )
-        if self.config.enable_adaptive_lr and in_body:
+        if (
+            self.config.enable_adaptive_lr
+            and self.config.allow_scheduler_override
+            and in_body
+        ):
             # Never second-guess the schedule during warmup (the plateau
             # heuristic would read the tiny ramping LR as "stuck" and pin
             # training at ~0 LR) or in the terminal decay phase (a plateau

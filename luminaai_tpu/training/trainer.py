@@ -99,9 +99,26 @@ class Trainer:
             loss_spike_threshold=config.loss_spike_threshold,
             grad_norm_threshold=config.grad_norm_threshold,
             health_check_interval=config.health_check_interval,
+            wandb_config={
+                "enable": config.enable_wandb,
+                "project": config.wandb_project,
+                "entity": config.wandb_entity,
+                "run_name": config.experiment_name,
+                "run_config": config.to_dict(),
+            },
         )
 
         self.global_step = 0
+        self._last_backup_time = time.time()
+        # Chinchilla-mode convergence stop (ref chinchilla_scaler's
+        # ConvergenceDetector): optional early end when eval loss flattens.
+        self._convergence = None
+        if config.use_chinchilla_scaling:
+            from luminaai_tpu.training.scaler import ConvergenceDetector
+
+            self._convergence = ConvergenceDetector(
+                patience=config.convergence_patience
+            )
         self.best_eval_loss = float("inf")
         self._epochs_without_improvement = 0
         self._consecutive_nonfinite = 0
@@ -373,12 +390,18 @@ class Trainer:
             self.config, self.model, self.shardings, self.mesh
         )
 
-    def train_with_oom_protection(self, max_attempts: int = 6) -> Dict[str, Any]:
+    def train_with_oom_protection(
+        self, max_attempts: Optional[int] = None
+    ) -> Dict[str, Any]:
         """OOM backoff ladder around train() (ref Main.py:292
         wrap_orchestrator_with_oom_protection). On device OOM: first split
         microbatches (in-jit, data pipeline untouched), then halve the
         global batch; each rung recompiles and resumes from the live state.
         """
+        if max_attempts is None:
+            # config.max_retries counts OOM recoveries; each may need a
+            # microbatch rung AND a batch rung, hence ×2.
+            max_attempts = max(2, self.config.max_retries * 2)
         for attempt in range(1, max_attempts + 1):
             try:
                 return self.train()
@@ -558,14 +581,37 @@ class Trainer:
                     if self._check_early_stopping(eval_metrics.get("eval_loss")):
                         stop = True
                         break
+                    if (
+                        self._convergence is not None
+                        and eval_metrics.get("eval_loss") is not None
+                        and self._convergence.update(
+                            eval_metrics["eval_loss"], self.global_step
+                        )
+                    ):
+                        logger.info(
+                            "convergence detected at step %d; stopping "
+                            "(chinchilla budget satisfied early)",
+                            self.global_step,
+                        )
+                        stop = True
+                        break
                     # Eval time isn't train throughput; restart the window.
                     window_t0, window_tokens = time.time(), 0
 
+                overdue_backup = (
+                    cfg.backup_every_n_hours > 0
+                    and time.time() - self._last_backup_time
+                    > cfg.backup_every_n_hours * 3600
+                )
                 if (
-                    self.global_step % cfg.save_every_n_batches == 0
+                    (
+                        self.global_step % cfg.save_every_n_batches == 0
+                        or overdue_backup
+                    )
                     and self._first_nonfinite_step is None  # not NaN-suspect
                 ):
-                    self.save_checkpoint(last_metrics)
+                    self.save_checkpoint(last_metrics, force=overdue_backup)
+                    self._last_backup_time = time.time()
                     window_t0, window_tokens = time.time(), 0
 
             if (

@@ -140,7 +140,9 @@ def check_config_fits(config, n_devices: Optional[int] = None) -> Dict[str, Any]
     dev = get_device_info()
     hbm = dev.get("memory_per_device_gb") or 16.0
     need = estimate_training_memory_gb(config)
-    fits = need["total_gb"] <= hbm * 0.92  # leave headroom for XLA scratch
+    # config.max_memory_usage caps usable HBM (headroom for XLA scratch).
+    budget = getattr(config, "max_memory_usage", 0.9)
+    fits = need["total_gb"] <= hbm * budget
     return {
         "fits": fits,
         "per_chip_gb": need["total_gb"],

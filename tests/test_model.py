@@ -225,3 +225,35 @@ class TestConfig:
         cfg.save(p)
         cfg2 = Config.load(p)
         assert cfg.to_dict() == cfg2.to_dict()
+
+
+def test_untied_embeddings_has_lm_head():
+    """tie_word_embeddings=False adds an independent output head used by
+    both the logits path and the fused-CE path."""
+    import jax
+
+    from tests.test_sharding import run_one_step, tiny_config
+
+    cfg = tiny_config(tie_word_embeddings=False)
+    from luminaai_tpu.models.transformer import LuminaTransformer
+
+    model = LuminaTransformer(cfg)
+    ids = jnp.ones((1, cfg.seq_length), jnp.int32)
+    params = model.init(jax.random.key(0), ids)["params"]
+    emb = params["embedder"]
+    assert "lm_head" in emb and emb["lm_head"].value.shape == (
+        cfg.vocab_size, cfg.hidden_size
+    )
+    _, m, _ = run_one_step(cfg)
+    assert jnp.isfinite(float(m["loss"]))
+
+
+def test_micro_batch_size_drives_accumulation():
+    from tests.test_sharding import run_one_step, tiny_config
+
+    cfg = tiny_config(micro_batch_size=2)  # batch 8 → accum 4
+    assert cfg.gradient_accumulation_steps == 4
+    base = tiny_config()
+    _, m, _ = run_one_step(cfg)
+    _, m0, _ = run_one_step(base)
+    assert abs(float(m["ce_loss"]) - float(m0["ce_loss"])) < 5e-2
