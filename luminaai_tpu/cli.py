@@ -351,6 +351,71 @@ def cmd_data(args) -> int:
     return 0
 
 
+def cmd_evaluate(args) -> int:
+    """Standalone perplexity/loss evaluation of a checkpoint on a jsonl
+    dataset (ref trainer.py:2667 evaluate, exposed without a Trainer)."""
+    import jax
+    import jax.numpy as jnp
+
+    from luminaai_tpu.data.dataset import ConversationDataset, conversation_batches
+    from luminaai_tpu.data.tokenizer import ConversationTokenizer
+    from luminaai_tpu.inference.chat import load_model_for_inference
+    from luminaai_tpu.parallel.train_step import (
+        _shifted_mask_weights,
+        shift_labels,
+    )
+    from luminaai_tpu.ops.fused import fused_lm_head_cross_entropy
+
+    model, params, cfg = load_model_for_inference(args.checkpoint)
+    if args.batch_size:
+        cfg.batch_size = args.batch_size
+    tokenizer = ConversationTokenizer(
+        assistant_loss_weight=cfg.assistant_loss_weight
+    )
+    ds = ConversationDataset(args.data, tokenizer, cfg, split="eval")
+
+    @jax.jit
+    def eval_batch(params, batch):
+        hidden, _ = model.apply(
+            {"params": params}, batch["input_ids"],
+            deterministic=True, return_hidden=True,
+        )
+        labels, valid = shift_labels(batch)
+        mask, weights = _shifted_mask_weights(batch, valid)
+        head = params["embedder"][
+            "embedding" if cfg.tie_word_embeddings else "lm_head"
+        ]
+        loss, metrics = fused_lm_head_cross_entropy(
+            hidden, head, labels, loss_mask=mask, loss_weights=weights,
+        )
+        return metrics
+
+    total_nll = total_tokens = 0.0
+    n_batches = 0
+    for batch in conversation_batches(
+        ds, cfg.batch_size, seed=0, drop_last=False
+    ):
+        if args.max_batches and n_batches >= args.max_batches:
+            break
+        m = eval_batch(params, {k: jnp.asarray(v) for k, v in batch.items()})
+        ntok = float(m["tokens_in_loss"])
+        total_nll += float(m["ce_loss"]) * ntok
+        total_tokens += ntok
+        n_batches += 1
+    if total_tokens == 0:
+        print("no evaluable tokens found", file=sys.stderr)
+        return 1
+    loss = total_nll / total_tokens
+    result = {
+        "eval_loss": round(loss, 4),
+        "perplexity": round(float(np.exp(min(loss, 20.0))), 2),
+        "tokens": int(total_tokens),
+        "batches": n_batches,
+    }
+    print(json.dumps(result, indent=2))
+    return 0
+
+
 def cmd_report(args) -> int:
     """HTML reports (ref utils/reporting.py)."""
     if args.kind == "training":
@@ -551,6 +616,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="acquire: rotate output shards after N conversations "
                         "(config.max_conversations_per_file equivalent)")
     d.set_defaults(fn=cmd_data)
+
+    e = sub.add_parser("evaluate", help="perplexity/loss on a dataset")
+    e.add_argument("--checkpoint", required=True)
+    e.add_argument("--data", required=True, help="jsonl conversations")
+    e.add_argument("--batch-size", dest="batch_size", type=int)
+    e.add_argument("--max-batches", dest="max_batches", type=int, default=0)
+    e.set_defaults(fn=cmd_evaluate)
 
     rp = sub.add_parser("report", help="HTML reports")
     rp.add_argument("kind", choices=["training", "data"])

@@ -163,3 +163,24 @@ def test_report_training_and_data(tmp_path, capsys):
 
     # training report on a dir without a summary fails cleanly
     assert run_cli(["report", "training", "--dir", str(tmp_path / "nope")]) == 1
+
+
+def test_evaluate_subcommand(tmp_path, capsys):
+    # Train briefly, then evaluate the checkpoint on sample data.
+    data = tmp_path / "conv.jsonl"
+    run_cli(["data", "sample", "--out", str(data), "--count", "24"])
+    capsys.readouterr()
+    out_dir = tmp_path / "run"
+    assert run_cli([
+        "train", "--preset", "debug", "--data", str(data),
+        "--steps", "3", "--output-dir", str(out_dir),
+        "--no-adaptive", "--no-oom-protect", "--quiet",
+        "--batch-size", "8",
+    ]) == 0
+    capsys.readouterr()
+    assert run_cli([
+        "evaluate", "--checkpoint", str(out_dir / "checkpoints"),
+        "--data", str(data), "--batch-size", "8", "--max-batches", "2",
+    ]) == 0
+    result = json.loads(capsys.readouterr().out)
+    assert result["tokens"] > 0 and result["perplexity"] > 1
