@@ -217,3 +217,33 @@ def test_scan_decode_matches_full_forward():
         np.asarray(full_ext[:, -1]), np.asarray(logits_dec[:, -1]),
         rtol=2e-5, atol=2e-5,
     )
+
+
+def test_big_preset_trace_time_is_depth_independent():
+    """b30/b100 (48/64 layers) must TRACE in seconds under scan_layers —
+    the r1 failure mode was trace/compile time growing linearly in depth
+    and blowing driver timeouts. eval_shape-only: no arrays materialize."""
+    import time
+
+    from luminaai_tpu.config import ConfigPresets
+    from luminaai_tpu.parallel.train_step import make_loss_fn
+
+    cfg = ConfigPresets.get("b30")
+    cfg.use_flash_attention = False
+    assert cfg.scan_layers, "big presets must default to scan_layers"
+    model = LuminaTransformer(cfg)
+    loss_fn = make_loss_fn(cfg, model)
+    dummy = jnp.zeros((1, cfg.seq_length), jnp.int32)
+    t0 = time.time()
+    shapes = jax.eval_shape(lambda r: model.init(r, dummy), jax.random.key(0))
+    batch = {
+        "input_ids": jax.ShapeDtypeStruct(
+            (cfg.batch_size, cfg.seq_length), jnp.int32
+        )
+    }
+    jax.eval_shape(
+        lambda p, b, r: jax.grad(loss_fn, has_aux=True)(p, b, r),
+        shapes["params"], batch, jax.ShapeDtypeStruct((2,), jnp.uint32),
+    )
+    elapsed = time.time() - t0
+    assert elapsed < 60, f"b30 grad trace took {elapsed:.0f}s"
