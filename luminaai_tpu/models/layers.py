@@ -257,17 +257,19 @@ class GQAttention(nn.Module):
                 out = ring_attention(
                     q, k, v, mesh, causal=True,
                     q_spec=q_spec, kv_spec=kv_spec,
+                    use_flash=cfg.use_flash_attention,
+                    block_q=cfg.flash_block_q,
+                    block_kv=cfg.flash_block_kv,
                 )
                 y = jnp.einsum("bshk,hkd->bsd", out, wo.astype(self.dtype))
                 return y, new_cache
 
+        from luminaai_tpu.ops.flash_attention import flash_eligible
+
         use_flash = (
             cfg.use_flash_attention
             and kv_cache is None
-            and S >= 128
-            and d % 64 == 0  # Mosaic pads 64→128 lanes; <64 not worth it
-            and S % min(cfg.flash_block_q, S) == 0
-            and S % min(cfg.flash_block_kv, S) == 0
+            and flash_eligible(S, d, cfg.flash_block_q, cfg.flash_block_kv)
         )
         if use_flash:
             from luminaai_tpu.ops.flash_attention import flash_attention

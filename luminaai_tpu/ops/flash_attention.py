@@ -212,7 +212,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         dv_ref[0, 0, :, :] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd(scale, causal, block_q, block_kv, res, g):
+def _bwd(scale, causal, block_q, block_kv, res, g, g_lse=None):
     q, k, v, out, lse_small = res
     do = g
     B, Sq, Hq, D = q.shape
@@ -228,6 +228,11 @@ def _bwd(scale, causal, block_q, block_kv, res, g):
     # lane-replicated layout only for the lifetime of the bwd kernels.
     lse = jnp.broadcast_to(lse_small[..., None], (*lse_small.shape, LANES))
     delta = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1)
+    if g_lse is not None:
+        # lse cotangent folds into delta: dlse/ds = p, so
+        # ds = p·(dp − delta + ḡ_lse) = p·(dp − (delta − ḡ_lse)) — the
+        # kernels need no change to also differentiate the lse output.
+        delta = delta - g_lse.astype(jnp.float32)
     delta = jnp.broadcast_to(delta[..., None], (*delta.shape, LANES))
 
     common_specs = [
@@ -291,25 +296,74 @@ def _bwd(scale, causal, block_q, block_kv, res, g):
     )
 
 
+# -- flash with exposed logsumexp (chunk-mergeable attention) ----------------
+# The plain flash_attention path is this same custom_vjp with the lse
+# output dropped (one implementation to keep in sync; a zero lse cotangent
+# costs one subtract in bwd, noise next to the kernels).
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, scale, causal, block_q, block_kv):
-    out, _ = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q, block_kv=block_kv)
-    return out
+def _flash_lse(q, k, v, scale, causal, block_q, block_kv):
+    out, lse = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q, block_kv=block_kv)
+    return out, lse[..., 0]
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_kv):
+def _flash_lse_fwd(q, k, v, scale, causal, block_q, block_kv):
     out, lse = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q, block_kv=block_kv)
     # Save lse de-replicated: [B, Hq, Sq] fp32 (2MB-scale) instead of the
     # kernel's [B, Hq, Sq, 128] layout (256MB-scale at flagship shapes) —
     # the lane-padded buffer lives only inside this fwd call (r1 OOM fix).
-    return out, (q, k, v, out, lse[..., 0])
+    lse_small = lse[..., 0]
+    return (out, lse_small), (q, k, v, out, lse_small)
 
 
-def _flash_bwd(scale, causal, block_q, block_kv, res, g):
-    return _bwd(scale, causal, block_q, block_kv, res, g)
+def _flash_lse_bwd(scale, causal, block_q, block_kv, res, g):
+    g_out, g_lse = g
+    return _bwd(scale, causal, block_q, block_kv, res, g_out, g_lse=g_lse)
 
 
-_flash.defvjp(_flash_fwd, _flash_bwd)
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = 512,
+    block_kv: int = 512,
+) -> tuple:
+    """flash_attention that also returns per-row logsumexp [B, Hq, Sq].
+
+    The (out, lse) pair makes chunks mergeable with the online-softmax
+    recurrence — ring attention combines per-ring-step chunk results this
+    way (ops/ring_attention.py). Differentiable in both outputs.
+    """
+    B, Sq, Hq, D = q.shape
+    Skv, Hkv = k.shape[1], k.shape[2]
+    assert Hq % Hkv == 0, "num q heads must be a multiple of kv heads"
+    block_q = min(block_q, Sq)
+    block_kv = min(block_kv, Skv)
+    assert Sq % block_q == 0 and Skv % block_kv == 0, (
+        f"seq lengths ({Sq},{Skv}) must divide block sizes ({block_q},{block_kv})"
+    )
+    if scale is None:
+        scale = 1.0 / (D**0.5)
+    return _flash_lse(q, k, v, scale, causal, block_q, block_kv)
+
+
+def flash_eligible(
+    seq_len: int, head_dim: int, block_q: int, block_kv: int
+) -> bool:
+    """Single source of truth for when the Pallas kernel applies:
+    long-enough sequence, lane-friendly head_dim (Mosaic pads 64→128 lanes;
+    below 64 the pad waste dominates), and blocks that divide the length."""
+    return (
+        seq_len >= 128
+        and head_dim % 64 == 0
+        and seq_len % min(block_q, seq_len) == 0
+        and seq_len % min(block_kv, seq_len) == 0
+    )
 
 
 def flash_attention(
@@ -324,17 +378,10 @@ def flash_attention(
 ) -> jax.Array:
     """Flash attention over [B, S, H, D] tensors (differentiable).
 
-    Supports GQA (k/v may have fewer heads than q). Sequence lengths must be
-    multiples of the block sizes; head_dim should be a lane multiple (128).
+    Supports GQA (k/v may have fewer heads than q). The block sizes must
+    divide the sequence lengths; head_dim should be a multiple of 64.
     """
-    B, Sq, Hq, D = q.shape
-    Skv, Hkv = k.shape[1], k.shape[2]
-    assert Hq % Hkv == 0, "num q heads must be a multiple of kv heads"
-    block_q = min(block_q, Sq)
-    block_kv = min(block_kv, Skv)
-    assert Sq % block_q == 0 and Skv % block_kv == 0, (
-        f"seq lengths ({Sq},{Skv}) must divide block sizes ({block_q},{block_kv})"
-    )
-    if scale is None:
-        scale = 1.0 / (D**0.5)
-    return _flash(q, k, v, scale, causal, block_q, block_kv)
+    return flash_attention_with_lse(
+        q, k, v, causal=causal, scale=scale,
+        block_q=block_q, block_kv=block_kv,
+    )[0]

@@ -65,6 +65,76 @@ def _chunk_update(qg, k, v, kv_idx, m, l, o, *, my_idx, sl_q, causal, scale):
     return m_new, l_new, o_new
 
 
+def _ring_attention_shard_flash(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    axis_size: int,
+    causal: bool,
+    block_q: int,
+    block_kv: int,
+) -> jax.Array:
+    """Flash-kernel ring body: each chunk runs the Pallas kernel (MXU-tiled,
+    no [Sq, Skv] logits in HBM) and returns (out, lse); chunks merge with
+    the online-softmax recurrence. Causal structure is per-chunk-static:
+    ring step 0 is always the diagonal (causal kernel); later steps are
+    either fully visible (flash, causal=False) or fully masked — the masked
+    case SKIPS the kernel via lax.cond, saving the whole chunk's FLOPs.
+    """
+    from luminaai_tpu.ops.flash_attention import flash_attention_with_lse
+
+    B, Sl, Hq, D = q.shape
+    my_idx = jax.lax.axis_index(axis_name)
+
+    def merge(acc, den, m, o_c, lse_c):
+        # o_c are per-chunk-normalized; weight chunks by exp(lse_c).
+        m_new = jnp.maximum(m, lse_c)
+        corr = jnp.exp(m - m_new)
+        w = jnp.exp(lse_c - m_new)
+        den = den * corr + w
+        w_t = w.transpose(0, 2, 1)[..., None]        # [B, Sl, Hq, 1]
+        corr_t = corr.transpose(0, 2, 1)[..., None]
+        acc = acc * corr_t + o_c.astype(jnp.float32) * w_t
+        return acc, den, m_new
+
+    # Step 0: always the diagonal chunk (own K/V) — causal within.
+    o_c, lse_c = flash_attention_with_lse(
+        q, k, v, causal=causal, block_q=block_q, block_kv=block_kv
+    )
+    acc = jnp.zeros((B, Sl, Hq, D), jnp.float32)
+    den = jnp.zeros((B, Hq, Sl), jnp.float32)
+    m = jnp.full((B, Hq, Sl), NEG_INF, jnp.float32)
+    acc, den, m = merge(acc, den, m, o_c, lse_c)
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    for step in range(1, axis_size):
+        k = jax.lax.ppermute(k, axis_name, perm)
+        v = jax.lax.ppermute(v, axis_name, perm)
+        kv_idx = (my_idx - step) % axis_size
+
+        def attend(ops):
+            q_, k_, v_ = ops
+            return flash_attention_with_lse(
+                q_, k_, v_, causal=False, block_q=block_q, block_kv=block_kv
+            )
+
+        def skip(ops):
+            return (
+                jnp.zeros((B, Sl, Hq, D), q.dtype),
+                jnp.full((B, Hq, Sl), NEG_INF, jnp.float32),
+            )
+
+        if causal:
+            o_c, lse_c = jax.lax.cond(kv_idx > my_idx, skip, attend, (q, k, v))
+        else:
+            o_c, lse_c = attend((q, k, v))
+        acc, den, m = merge(acc, den, m, o_c, lse_c)
+
+    return (acc / den.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+
 def _ring_attention_shard(
     q: jax.Array,
     k: jax.Array,
@@ -114,26 +184,48 @@ def ring_attention(
     axis_name: str = "sequence",
     q_spec: Optional[PartitionSpec] = None,
     kv_spec: Optional[PartitionSpec] = None,
+    use_flash: bool = False,
+    block_q: int = 512,
+    block_kv: int = 512,
 ) -> jax.Array:
     """Sequence-parallel attention over `axis_name` of `mesh`.
 
     q: [B, S, Hq, D]; k/v: [B, S, Hkv, D] — global (pjit-view) arrays with S
     divisible by the axis size. q_spec/kv_spec describe how the caller's
     activations map onto the mesh (default: batch over (data, fsdp), length
-    over the ring axis, heads unsharded). Returns [B, S, Hq, D].
+    over the ring axis, heads unsharded). use_flash runs each ring chunk
+    through the Pallas kernel (and skips fully-masked chunks outright) when
+    the per-shard length is kernel-eligible (block sizes must divide it —
+    flash_eligible); otherwise it silently falls back to the einsum chunk
+    path. Returns [B, S, Hq, D].
     """
+    from luminaai_tpu.ops.flash_attention import flash_eligible
+
     axis_size = mesh.shape[axis_name]
     if q_spec is None:
         q_spec = PartitionSpec(("data", "fsdp"), axis_name, None, None)
     if kv_spec is None:
         kv_spec = PartitionSpec(("data", "fsdp"), axis_name, None, None)
 
-    fn = functools.partial(
-        _ring_attention_shard,
-        axis_name=axis_name,
-        axis_size=axis_size,
-        causal=causal,
-    )
+    local_len = q.shape[1] // axis_size
+    if use_flash and flash_eligible(
+        local_len, q.shape[-1], block_q, block_kv
+    ):
+        fn = functools.partial(
+            _ring_attention_shard_flash,
+            axis_name=axis_name,
+            axis_size=axis_size,
+            causal=causal,
+            block_q=min(block_q, local_len),
+            block_kv=min(block_kv, local_len),
+        )
+    else:
+        fn = functools.partial(
+            _ring_attention_shard,
+            axis_name=axis_name,
+            axis_size=axis_size,
+            causal=causal,
+        )
     sharded = jax.shard_map(
         fn,
         mesh=mesh,
