@@ -416,6 +416,58 @@ def cmd_evaluate(args) -> int:
     return 0
 
 
+def cmd_convert(args) -> int:
+    """Convert a checkpoint between per-layer and scanned param layouts
+    (the same weights, bit-identical outputs — models/transformer.py
+    stack/unstack_params_for_scan), so scan_layers can change between
+    runs without retraining."""
+    import dataclasses as dc
+
+    import jax
+    import orbax.checkpoint as ocp
+
+    from luminaai_tpu.config import Config
+    from luminaai_tpu.inference.chat import load_model_for_inference
+    from luminaai_tpu.models.transformer import (
+        stack_params_for_scan,
+        unstack_params_from_scan,
+    )
+
+    _, params, cfg = load_model_for_inference(args.checkpoint)
+    is_scanned = any(k.startswith("scan_") for k in params)
+    if args.to == "scan" and is_scanned:
+        print("checkpoint is already in scanned layout", file=sys.stderr)
+        return 1
+    if args.to == "plain" and not is_scanned:
+        print("checkpoint is already in per-layer layout", file=sys.stderr)
+        return 1
+
+    if args.to == "scan":
+        new_cfg = dc.replace(cfg, scan_layers=True)
+        new_params = stack_params_for_scan(new_cfg, params)
+    else:
+        new_params = unstack_params_from_scan(cfg, params)
+        new_cfg = dc.replace(cfg, scan_layers=False)
+
+    out = Path(args.out).absolute()
+    out.mkdir(parents=True, exist_ok=True)
+    with ocp.CheckpointManager(out) as mngr:
+        mngr.save(
+            0,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave({"params": new_params}),
+                metadata=ocp.args.JsonSave(
+                    {"step": 0, "config": new_cfg.to_dict(),
+                     "converted_from": str(args.checkpoint)}
+                ),
+            ),
+        )
+        mngr.wait_until_finished()
+    n = sum(x.size for x in jax.tree.leaves(new_params))
+    print(f"converted to {args.to} layout: {n / 1e6:.1f}M params -> {out}")
+    return 0
+
+
 def cmd_report(args) -> int:
     """HTML reports (ref utils/reporting.py)."""
     if args.kind == "training":
@@ -616,6 +668,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="acquire: rotate output shards after N conversations "
                         "(config.max_conversations_per_file equivalent)")
     d.set_defaults(fn=cmd_data)
+
+    cv = sub.add_parser(
+        "convert", help="convert checkpoint layer layout (scan <-> plain)"
+    )
+    cv.add_argument("--checkpoint", required=True)
+    cv.add_argument("--to", choices=["scan", "plain"], required=True)
+    cv.add_argument("--out", required=True)
+    cv.set_defaults(fn=cmd_convert)
 
     e = sub.add_parser("evaluate", help="perplexity/loss on a dataset")
     e.add_argument("--checkpoint", required=True)

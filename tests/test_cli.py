@@ -184,3 +184,38 @@ def test_evaluate_subcommand(tmp_path, capsys):
     ]) == 0
     result = json.loads(capsys.readouterr().out)
     assert result["tokens"] > 0 and result["perplexity"] > 1
+
+
+def test_convert_checkpoint_layout_roundtrip(tmp_path, capsys):
+    out_dir = tmp_path / "run"
+    assert run_cli([
+        "train", "--preset", "debug", "--synthetic", "--steps", "2",
+        "--output-dir", str(out_dir), "--no-adaptive", "--no-oom-protect",
+        "--quiet", "--batch-size", "8",
+    ]) == 0
+    capsys.readouterr()
+    ckpt = str(out_dir / "checkpoints")
+    scan_dir = tmp_path / "scanned"
+    assert run_cli([
+        "convert", "--checkpoint", ckpt, "--to", "scan", "--out",
+        str(scan_dir),
+    ]) == 0
+    # Converting an already-scanned checkpoint is refused.
+    again = tmp_path / "again"
+    assert run_cli([
+        "convert", "--checkpoint", str(scan_dir), "--to", "scan",
+        "--out", str(again),
+    ]) == 1
+    # Same weights, identical logits across layouts.
+    import jax
+    import jax.numpy as jnp
+
+    from luminaai_tpu.inference.chat import load_model_for_inference
+
+    m1, p1, c1 = load_model_for_inference(ckpt)
+    m2, p2, c2 = load_model_for_inference(str(scan_dir))
+    assert c2.scan_layers and not c1.scan_layers
+    ids = jnp.ones((1, 16), jnp.int32)
+    l1, _ = m1.apply({"params": p1}, ids, deterministic=True)
+    l2, _ = m2.apply({"params": p2}, ids, deterministic=True)
+    assert float(jnp.abs(l1 - l2).max()) < 2e-2  # bf16 serving cast + scan op order
