@@ -198,6 +198,45 @@ def cmd_train(args) -> int:
             f"applied={'yes' if args.steps is None else 'no, --steps set'})"
         )
 
+    # Rough wall-clock estimate before committing compute (ref Main.py:1008
+    # estimate_and_display_training_time).
+    steps = cfg.max_steps or 0
+    if steps and not args.quiet:
+        tok_per_step = cfg.batch_size * cfg.seq_length
+        # ~40% MFU planning number on detected hardware; CPU ≈ debug only.
+        from luminaai_tpu.utils.environment import get_device_info
+
+        dev = get_device_info()
+        peak = {"tpu": 197e12, "gpu": 312e12}.get(dev["platform"], 5e11)
+        est_tps = max(
+            1.0,
+            0.4 * peak * dev["device_count"]
+            / (6 * max(cfg.estimate_active_parameters(), 1)),
+        )
+        hours = steps * tok_per_step / est_tps / 3600
+        print(
+            f"estimated training time: ~{hours:.2f}h for {steps} steps "
+            f"({tok_per_step * steps / 1e6:.0f}M tokens at ~{est_tps:,.0f} "
+            "tok/s planning rate)"
+        )
+
+    # Start-of-run experiment metadata (ref Main.py:1192
+    # save_experiment_metadata) — written before the trainer is even built
+    # so any crash still leaves provenance on disk. A resume never
+    # overwrites the original run's record.
+    meta_path = Path(cfg.output_dir) / "experiment_metadata.json"
+    if not (args.resume and meta_path.exists()):
+        meta_path.parent.mkdir(parents=True, exist_ok=True)
+        meta_path.write_text(json.dumps(_jsonable({
+            "experiment_name": cfg.experiment_name,
+            "config": cfg.to_dict(),
+            "total_params": cfg.estimate_parameters(),
+            "active_params": cfg.estimate_active_parameters(),
+            "dataset_tokens": dataset_tokens,
+            "planned_steps": cfg.max_steps,
+            "argv": sys.argv[1:],
+        }), indent=2))
+
     trainer = Trainer(cfg, train_data=train_fn, eval_data=eval_fn)
     _install_signal_handlers(trainer)
 
@@ -348,6 +387,45 @@ def cmd_data(args) -> int:
             args.inp, ConversationTokenizer()
         )
         print(json.dumps(_jsonable(report), indent=2))
+    elif args.action == "blend":
+        # Weighted multi-source blend → one jsonl (ref Main.py:1350
+        # setup_multi_dataset_training + multi_source main()). --sources
+        # takes name=weight=glob triples.
+        import glob as globlib
+
+        from luminaai_tpu.data.multi_source import MultiSourcePipeline
+        from luminaai_tpu.data.tokenizer import ConversationTokenizer
+
+        if not args.sources:
+            print(
+                "blend requires --sources name=weight=glob [...]",
+                file=sys.stderr,
+            )
+            return 2
+        weights: Dict[str, float] = {}
+        shards: Dict[str, List[str]] = {}
+        for spec in args.sources:
+            try:
+                name, weight, pattern = spec.split("=", 2)
+                weights[name] = float(weight)
+            except ValueError:
+                print(f"bad --sources entry {spec!r}", file=sys.stderr)
+                return 2
+            shards[name] = sorted(globlib.glob(pattern))
+            if not shards[name]:
+                print(f"no files match {pattern!r}", file=sys.stderr)
+                return 2
+        if sum(weights.values()) <= 0:
+            print("--sources weights must sum to > 0", file=sys.stderr)
+            return 2
+        pipeline = MultiSourcePipeline(ConversationTokenizer(), weights)
+        out_path = args.out or "blended.jsonl"
+        n = 0
+        with open(out_path, "w", encoding="utf-8") as f:
+            for rec in pipeline.iter_blended(shards):
+                f.write(json.dumps(rec, ensure_ascii=False) + "\n")
+                n += 1
+        print(f"blended {n} documents from {len(shards)} sources -> {out_path}")
     return 0
 
 
@@ -659,7 +737,11 @@ def build_parser() -> argparse.ArgumentParser:
     b.set_defaults(fn=cmd_benchmark)
 
     d = sub.add_parser("data", help="dataset utilities")
-    d.add_argument("action", choices=["sample", "oasst", "validate", "acquire"])
+    d.add_argument(
+        "action", choices=["sample", "oasst", "validate", "acquire", "blend"]
+    )
+    d.add_argument("--sources", nargs="*",
+                   help="blend: name=weight=glob triples")
     d.add_argument("--in", dest="inp")
     d.add_argument("--out")
     d.add_argument("--count", type=int, default=100)

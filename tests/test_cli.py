@@ -219,3 +219,38 @@ def test_convert_checkpoint_layout_roundtrip(tmp_path, capsys):
     l1, _ = m1.apply({"params": p1}, ids, deterministic=True)
     l2, _ = m2.apply({"params": p2}, ids, deterministic=True)
     assert float(jnp.abs(l1 - l2).max()) < 2e-2  # bf16 serving cast + scan op order
+
+
+def test_data_blend_subcommand(tmp_path, capsys):
+    for name, texts in {
+        "wiki": ["wiki article one " * 30, "wiki article two " * 30],
+        "web": ["web page " * 40],
+    }.items():
+        with open(tmp_path / f"{name}.jsonl", "w") as f:
+            for t in texts:
+                f.write(json.dumps({"text": t, "source": name}) + "\n")
+    out = tmp_path / "blend.jsonl"
+    assert run_cli([
+        "data", "blend", "--out", str(out),
+        "--sources",
+        f"wiki=0.7={tmp_path}/wiki.jsonl",
+        f"web=0.3={tmp_path}/web.jsonl",
+    ]) == 0
+    lines = [json.loads(l) for l in out.read_text().splitlines()]
+    assert len(lines) == 3
+    assert {l["source"] for l in lines} == {"wiki", "web"}
+    # malformed spec fails cleanly
+    assert run_cli(["data", "blend", "--sources", "bad-spec"]) == 2
+
+
+def test_train_writes_experiment_metadata(tmp_path, capsys):
+    out_dir = tmp_path / "run"
+    assert run_cli([
+        "train", "--preset", "debug", "--synthetic", "--steps", "2",
+        "--output-dir", str(out_dir), "--no-adaptive", "--no-oom-protect",
+        "--batch-size", "8",
+    ]) == 0
+    captured = capsys.readouterr().out
+    assert "estimated training time" in captured
+    meta = json.loads((out_dir / "experiment_metadata.json").read_text())
+    assert meta["planned_steps"] == 2 and meta["total_params"] > 0
