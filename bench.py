@@ -60,6 +60,22 @@ def _child_config(name: str, n_chips: int = 1):
             use_flash_attention=True,
             gradient_checkpointing=True,
         )
+    if name == "dense200":
+        # ~200M dense comparison point (ref BENCHMARKS.md "200M dense
+        # ~119k tok/s"). Manual rung: python bench.py --child dense200.
+        return Config(
+            vocab_size=32768,
+            hidden_size=896,
+            num_layers=20,
+            num_heads=14,
+            num_kv_heads=7,
+            seq_length=2048,
+            batch_size=16 * n_chips,
+            use_moe=False,
+            precision="bf16",
+            use_flash_attention=True,
+            gradient_checkpointing=True,
+        )
     # cpu_fallback: tiny model so a flaky/absent TPU still yields a number
     # (flagged via extras.platform + error note; vs_baseline not meaningful).
     return Config(
