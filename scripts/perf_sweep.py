@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Flagship perf sweep on the real chip: time step variants with honest
+host-transfer sync. Usage: python perf_sweep.py [variant ...]"""
+import sys, time, gc
+sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+import numpy as np
+import jax, jax.numpy as jnp
+
+sys.argv_names = sys.argv[1:]
+
+import dataclasses
+from bench import _child_config
+from luminaai_tpu.models.transformer import LuminaTransformer
+from luminaai_tpu.parallel.mesh import build_mesh
+from luminaai_tpu.parallel.sharding import init_sharded_state
+from luminaai_tpu.parallel.train_step import make_train_step
+from luminaai_tpu.training.optimizer import make_optimizer, make_schedule
+
+BASE = _child_config("flagship", 1)
+
+VARIANTS = {
+    "base": {},
+    "dots": {"remat_policy": "dots_saveable"},
+    "noremat": {"gradient_checkpointing": False},
+    "scan": {"scan_layers": True},
+    "einsum": {"moe_dispatch": "einsum"},
+    "chunk512": {"loss_chunk_size": 512},
+    "blk1024": {"flash_block_kv": 1024},
+    "noflash": {"use_flash_attention": False},
+    "scan_dots": {"scan_layers": True, "remat_policy": "dots_saveable"},
+}
+
+names = sys.argv[1:] or ["base", "dots", "scan", "einsum"]
+ids = np.random.RandomState(0).randint(
+    1, BASE.vocab_size, size=(BASE.batch_size, BASE.seq_length)
+)
+
+for name in names:
+    cfg = dataclasses.replace(BASE, **VARIANTS[name])
+    try:
+        model = LuminaTransformer(cfg)
+        schedule = make_schedule(cfg, 1000)
+        tx = make_optimizer(cfg, 1000, schedule)
+        mesh = build_mesh(cfg)
+        state, shardings = init_sharded_state(
+            cfg, model, tx, mesh, jax.random.key(0)
+        )
+        step = make_train_step(cfg, model, shardings, mesh, schedule, tx)
+        batch = {"input_ids": jnp.asarray(ids, jnp.int32)}
+        t0 = time.perf_counter()
+        state, m = step(state, batch)
+        float(m["loss"])
+        compile_s = time.perf_counter() - t0
+        state, m = step(state, batch)
+        float(m["loss"])
+        n = 6
+        t0 = time.perf_counter()
+        for _ in range(n):
+            state, m = step(state, batch)
+        float(m["loss"])
+        dt = (time.perf_counter() - t0) / n
+        tps = cfg.batch_size * cfg.seq_length / dt
+        print(f"{name:10s} step {dt*1e3:8.1f} ms  {tps:9.0f} tok/s "
+              f"compile {compile_s:6.1f}s loss {float(m['loss']):.3f}",
+              flush=True)
+        del state, step, m, batch
+        gc.collect()
+    except Exception as e:
+        print(f"{name:10s} FAILED: {str(e).splitlines()[0][:160]}", flush=True)
