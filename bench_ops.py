@@ -105,7 +105,7 @@ def bench_moe_dispatch(G=8, S=2048, H=512, E=8, k=2, F=1408) -> List[Dict]:
     )
 
     rows = []
-    for mode in ("sort", "einsum"):
+    for mode in ("sort", "gather", "einsum"):
         c = dataclasses.replace(cfg, moe_dispatch=mode)
         layer = MoELayer(c)
         params = layer.init(jax.random.key(0), x)

@@ -66,9 +66,12 @@ class Config:
     dense_start_layers: int = 2
     dense_end_layers: int = 2
     expert_output_scaling: float = 1.0
-    # 'sort' = scatter/gather dispatch via flat slot ids (linear memory, the
-    # default); 'einsum' = GShard one-hot dispatch (O(S·E·C) memory, MXU-only
-    # data movement — useful for A/B in bench_ops).
+    # 'sort' = scatter/gather dispatch via flat slot ids (linear memory);
+    # 'gather' = same routing, but the expert buffers are filled by a row
+    # GATHER through an inverted slot→token index table (the H-wide scatter
+    # moves to the backward pass — TPUs execute row gathers much better);
+    # 'einsum' = GShard one-hot dispatch (O(S·E·C) memory, MXU-only data
+    # movement — useful for A/B in bench_ops).
     moe_dispatch: str = "sort"
 
     # --- MoD (mixture of depths) ---
@@ -243,7 +246,7 @@ class Config:
                 f"invalid moe_pattern {self.moe_pattern}"
             )
             assert self.capacity_factor > 0
-            assert self.moe_dispatch in ("sort", "einsum"), (
+            assert self.moe_dispatch in ("sort", "gather", "einsum"), (
                 f"invalid moe_dispatch {self.moe_dispatch}"
             )
         if self.use_mod:

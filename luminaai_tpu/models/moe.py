@@ -198,7 +198,7 @@ class MoELayer(nn.Module):
             gate_logits = gate_logits + noise
         router_probs = jax.nn.softmax(gate_logits, axis=-1)
 
-        if cfg.moe_dispatch == "sort":
+        if cfg.moe_dispatch in ("sort", "gather"):
             # Sort-based dispatch: scatter/gather via flat slot ids — no
             # [G,S,E,C] one-hot tensors (see _sort_routing). The expert FFN
             # below still runs dense [E,G,C,·] matmuls on the MXU.
@@ -210,17 +210,41 @@ class MoELayer(nn.Module):
                 jnp.arange(S)[:, None], (S, k)
             ).reshape(-1)
 
-            def scatter_group(xg, slot_g):
-                # Spill row E*C absorbs dropped pairs, sliced off after.
-                buf = jnp.zeros((E * capacity + 1, H), dtype=self.dtype)
-                return buf.at[slot_g.reshape(-1)].set(xg[tok])
+            if cfg.moe_dispatch == "gather":
+                # Invert slot→token into an index table first (cheap int32
+                # scatter), then fill the expert buffers with a row GATHER.
+                # TPU executes H-wide row gathers far better than row
+                # scatters; the H-wide scatter-add moves to the backward,
+                # where the combine path's gather VJP was already one.
+                def invert_group(slot_g):
+                    inv = jnp.full((E * capacity + 1,), S, jnp.int32)
+                    return inv.at[slot_g.reshape(-1)].set(
+                        tok.astype(jnp.int32)
+                    )[: E * capacity]
 
-            buf = jax.vmap(scatter_group)(x.astype(self.dtype), slot)
-            expert_in = (
-                buf[:, : E * capacity]
-                .reshape(G, E, capacity, H)
-                .transpose(1, 0, 2, 3)
-            )
+                inv = jax.vmap(invert_group)(slot)  # [G, E*C] token ids
+                # Unfilled slots (inv == S) gather an arbitrary row and are
+                # zeroed by the mask — avoids concatenating a zero row onto
+                # x (a whole-activation HBM copy per layer).
+                filled = (inv < S)[..., None].astype(self.dtype)
+                buf = (
+                    jnp.take_along_axis(
+                        x.astype(self.dtype),
+                        jnp.minimum(inv, S - 1)[..., None],
+                        axis=1,
+                    )
+                    * filled
+                )  # [G, E*C, H]
+            else:
+
+                def scatter_group(xg, slot_g):
+                    # Spill row E*C absorbs dropped pairs, sliced off after.
+                    buf = jnp.zeros((E * capacity + 1, H), dtype=self.dtype)
+                    return buf.at[slot_g.reshape(-1)].set(xg[tok])
+
+                buf = jax.vmap(scatter_group)(x.astype(self.dtype), slot)
+                buf = buf[:, : E * capacity]
+            expert_in = buf.reshape(G, E, capacity, H).transpose(1, 0, 2, 3)
             tokens_per_expert = counts.astype(jnp.float32).sum(axis=0)
         else:
             dispatch, combine_w, dropped = _top_k_routing(
@@ -244,7 +268,7 @@ class MoELayer(nn.Module):
             expert_out, ("expert", "activation_exp_batch", None, None)
         )
 
-        if cfg.moe_dispatch == "sort":
+        if cfg.moe_dispatch in ("sort", "gather"):
             out_flat = expert_out.transpose(1, 0, 2, 3).reshape(
                 G, E * capacity, H
             )

@@ -6,8 +6,6 @@ sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(_
 import numpy as np
 import jax, jax.numpy as jnp
 
-sys.argv_names = sys.argv[1:]
-
 import dataclasses
 from bench import _child_config
 from luminaai_tpu.models.transformer import LuminaTransformer
@@ -28,6 +26,7 @@ VARIANTS = {
     "blk1024": {"flash_block_kv": 1024},
     "noflash": {"use_flash_attention": False},
     "scan_dots": {"scan_layers": True, "remat_policy": "dots_saveable"},
+    "gatherd": {"moe_dispatch": "gather"},
 }
 
 names = sys.argv[1:] or ["base", "dots", "scan", "einsum"]
@@ -36,8 +35,8 @@ ids = np.random.RandomState(0).randint(
 )
 
 for name in names:
-    cfg = dataclasses.replace(BASE, **VARIANTS[name])
     try:
+        cfg = dataclasses.replace(BASE, **VARIANTS[name])
         model = LuminaTransformer(cfg)
         schedule = make_schedule(cfg, 1000)
         tx = make_optimizer(cfg, 1000, schedule)

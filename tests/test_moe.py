@@ -141,3 +141,46 @@ class TestMoD:
         changed = (jnp.abs(out[0]).sum(-1) > 1.0).sum()
         assert int(changed) == 4
         assert float(metrics["mod_compute_ratio"]) == pytest.approx(0.25)
+
+
+class TestDispatchModes:
+    """sort / gather / einsum dispatch must agree in outputs AND grads —
+    they are alternative buffer-construction strategies around identical
+    routing semantics (moe.py _sort_routing vs _top_k_routing)."""
+
+    def _run(self, mode, x):
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            moe_config(routing_noise_std=0.0), moe_dispatch=mode
+        )
+        layer = MoELayer(cfg, dtype=jnp.float32)
+        params = layer.init(jax.random.PRNGKey(0), x)
+
+        def loss(p, x):
+            out, _ = layer.apply(p, x)
+            return jnp.sum(out**2)
+
+        out, metrics = layer.apply(params, x)
+        grads = jax.grad(loss)(params, x)
+        return out, metrics, grads
+
+    def test_modes_equivalent(self):
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 64, 64))
+        ref_out, ref_m, ref_g = self._run("sort", x)
+        for mode in ("gather", "einsum"):
+            out, m, g = self._run(mode, x)
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(ref_out), atol=1e-5, rtol=1e-5
+            )
+            assert float(m["moe_drop_rate"]) == pytest.approx(
+                float(ref_m["moe_drop_rate"]), abs=1e-6
+            )
+            for (ka, a), (kb, b) in zip(
+                jax.tree_util.tree_leaves_with_path(ref_g),
+                jax.tree_util.tree_leaves_with_path(g),
+            ):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4,
+                    err_msg=f"grad mismatch {mode} at {ka}",
+                )
