@@ -102,7 +102,15 @@ class Config:
     quantization_method: Optional[str] = None
     quantization_bits: int = 8
     gradient_checkpointing: bool = True
-    remat_policy: str = "nothing_saveable"  # nothing_saveable|dots_saveable|full
+    # nothing_saveable = recompute everything (min HBM);
+    # save_outs = store each block's attention/FFN outputs (2 x [B,S,H]
+    #   bf16 per layer) so the backward recomputes only the branch being
+    #   differentiated — most of dots_saveable's win at ~1% of its HBM;
+    # dots_saveable = store every matmul output; full = no remat.
+    remat_policy: str = "nothing_saveable"  # nothing_saveable|save_outs|dots_saveable|full
+    # Adam first-moment dtype: None = fp32; 'bf16' halves mu's HBM
+    # (2 bytes/param) — nu stays fp32 (variance needs the exponent range).
+    adam_mu_dtype: Optional[str] = None
     scan_layers: bool = False  # lax.scan over layers (homogeneous stacks)
     donate_state: bool = True
     eval_every_n_batches: int = 500
@@ -261,6 +269,12 @@ class Config:
                 "defeating sequence parallelism)"
             )
         assert self.loss_chunk_size > 0, "loss_chunk_size must be positive"
+        assert self.remat_policy in (
+            "nothing_saveable", "save_outs", "dots_saveable", "full",
+        ), f"invalid remat_policy {self.remat_policy}"
+        assert self.adam_mu_dtype in (None, "bf16"), (
+            f"invalid adam_mu_dtype {self.adam_mu_dtype}"
+        )
         for axis in ("fsdp", "expert", "tensor", "sequence"):
             size = getattr(self, f"{axis}_parallel_size")
             assert size >= 1, f"{axis}_parallel_size must be >= 1"

@@ -347,12 +347,17 @@ class Embedder(nn.Module):
         return x
 
     def decode(self, x: jax.Array) -> jax.Array:
-        # fp32 logits for a numerically stable softmax/CE.
+        # fp32 logits (accumulated via preferred_element_type) for a
+        # numerically stable softmax/CE; operands stay in the compute dtype
+        # so the MXU runs bf16 passes instead of fp32 ones.
         head = (
             self.embedding
             if self.config.tie_word_embeddings
             else self.lm_head
         )
         return jnp.einsum(
-            "bsd,vd->bsv", x.astype(jnp.float32), head.astype(jnp.float32)
+            "bsd,vd->bsv",
+            x,
+            head.astype(x.dtype),
+            preferred_element_type=jnp.float32,
         )

@@ -16,6 +16,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from flax import linen as nn
 
 from luminaai_tpu.config import Config
@@ -27,8 +28,16 @@ Dtype = Any
 
 REMAT_POLICIES = {
     "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
+    # Store each block's two branch outputs (checkpoint_name tags below):
+    # the backward then recomputes only the branch it is differentiating,
+    # instead of the whole block, for 2 x [B,S,H] bf16 per layer of HBM.
+    "save_outs": jax.checkpoint_policies.save_only_these_names(
+        "attn_out", "ffn_out"
+    ),
     "dots_saveable": jax.checkpoint_policies.dots_saveable,
-    "full": None,
+    # 'full' = save everything, i.e. no recomputation (jax.checkpoint with
+    # this policy is a no-op memory-wise; use it to A/B remat itself).
+    "full": jax.checkpoint_policies.everything_saveable,
 }
 
 
@@ -64,6 +73,7 @@ class TransformerBlock(nn.Module):
             kv_cache=kv_cache,
             cache_index=cache_index,
         )
+        h = checkpoint_name(h, "attn_out")
         x = x + h
         x = nn.with_logical_constraint(
             x, ("activation_batch", "activation_length", "activation_embed")
@@ -100,6 +110,7 @@ class TransformerBlock(nn.Module):
                 name="ffn",
             )(y)
 
+        ffn_out = checkpoint_name(ffn_out, "ffn_out")
         x = x + ffn_out
         x = nn.with_logical_constraint(
             x, ("activation_batch", "activation_length", "activation_embed")

@@ -113,8 +113,14 @@ def fused_lm_head_cross_entropy(
     w_chunks = to_chunks(weights)
 
     def chunk_stats(emb, h_c, l_c, w_c):
+        # Operands stay in the model compute dtype (bf16 in training) —
+        # an fp32xfp32 MXU pass costs several bf16 passes — while
+        # preferred_element_type keeps fp32 accumulation for the CE math.
         logits = jnp.einsum(
-            "bch,vh->bcv", h_c.astype(jnp.float32), emb.astype(jnp.float32)
+            "bch,vh->bcv",
+            h_c,
+            emb.astype(h_c.dtype),
+            preferred_element_type=jnp.float32,
         )
         lse = jax.nn.logsumexp(logits, axis=-1)  # [B, c]
         label_logit = jnp.take_along_axis(logits, l_c[..., None], axis=-1)[..., 0]

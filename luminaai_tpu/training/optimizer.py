@@ -68,6 +68,7 @@ def make_optimizer(
     the pre-clip norm to monitoring, ref cuda_kernels.py FusedGradClip)."""
     if schedule is None:
         schedule = make_schedule(config, total_steps)
+    mu_dtype = "bfloat16" if config.adam_mu_dtype == "bf16" else None
     return optax.adamw(
         learning_rate=schedule,
         b1=config.beta1,
@@ -75,4 +76,5 @@ def make_optimizer(
         eps=config.eps,
         weight_decay=config.weight_decay,
         mask=_decay_mask,
+        mu_dtype=mu_dtype,
     )

@@ -27,12 +27,21 @@ VARIANTS = {
     "noflash": {"use_flash_attention": False},
     "scan_dots": {"scan_layers": True, "remat_policy": "dots_saveable"},
     "gatherd": {"moe_dispatch": "gather"},
+    "saveouts": {"remat_policy": "save_outs"},
+    "saveouts_gather": {"remat_policy": "save_outs", "moe_dispatch": "gather"},
+    "mu16": {"adam_mu_dtype": "bf16"},
+    "mu16_dots": {"adam_mu_dtype": "bf16", "remat_policy": "dots_saveable"},
+    "chunk1024": {"loss_chunk_size": 1024},
+    "b24": {"batch_size": 24, "micro_batch_size": None},
+    "b24_saveouts_gather": {
+        "batch_size": 24,
+        "micro_batch_size": None,
+        "remat_policy": "save_outs",
+        "moe_dispatch": "gather",
+    },
 }
 
 names = sys.argv[1:] or ["base", "dots", "scan", "einsum"]
-ids = np.random.RandomState(0).randint(
-    1, BASE.vocab_size, size=(BASE.batch_size, BASE.seq_length)
-)
 
 for name in names:
     try:
@@ -45,6 +54,9 @@ for name in names:
             cfg, model, tx, mesh, jax.random.key(0)
         )
         step = make_train_step(cfg, model, shardings, mesh, schedule, tx)
+        ids = np.random.RandomState(0).randint(
+            1, cfg.vocab_size, size=(cfg.batch_size, cfg.seq_length)
+        )
         batch = {"input_ids": jnp.asarray(ids, jnp.int32)}
         t0 = time.perf_counter()
         state, m = step(state, batch)

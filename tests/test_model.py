@@ -257,3 +257,33 @@ def test_micro_batch_size_drives_accumulation():
     _, m, _ = run_one_step(cfg)
     _, m0, _ = run_one_step(base)
     assert abs(float(m["ce_loss"]) - float(m0["ce_loss"])) < 5e-2
+
+
+class TestRematPolicies:
+    """Gradients must be identical across remat policies — they trade
+    memory for recompute, never numerics (transformer.py REMAT_POLICIES)."""
+
+    def test_policies_same_grads(self):
+        rng = jax.random.PRNGKey(0)
+        cfg = tiny_config(
+            use_moe=True, num_experts=4, routing_noise_std=0.0,
+            gradient_checkpointing=True,
+        )
+        ids = jax.random.randint(rng, (2, cfg.seq_length), 0, cfg.vocab_size)
+
+        def grads_for(policy):
+            c = dataclasses.replace(cfg, remat_policy=policy)
+            model = LuminaTransformer(c)
+            variables = model.init({"params": rng}, ids)
+
+            def loss(p):
+                lg, aux = model.apply({"params": p}, ids)
+                return lg.astype(jnp.float32).mean() + aux["aux_loss"]
+
+            return jax.grad(loss)(variables["params"])
+
+        ref = grads_for("nothing_saveable")
+        for policy in ("save_outs", "dots_saveable"):
+            g = grads_for(policy)
+            for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(g)):
+                assert jnp.allclose(a, b, atol=1e-5), policy

@@ -140,3 +140,28 @@ def test_health_monitor_logs_jsonl(tmp_path):
     assert len(lines) == 5
     m.save_health_report(str(tmp_path / "health.json"))
     assert (tmp_path / "health.json").exists()
+
+
+def test_adam_mu_bf16_trains(tmp_path):
+    """adam_mu_dtype='bf16' halves mu HBM; training must still converge and
+    the stored first moment must actually be bf16."""
+    import dataclasses
+
+    import jax.numpy as jnp
+    import optax
+
+    from luminaai_tpu.training.optimizer import make_optimizer
+
+    cfg = dataclasses.replace(tiny_config(tmp_path), adam_mu_dtype="bf16")
+    tx = make_optimizer(cfg, 10)
+    params = {"w": jnp.zeros((4, 4), jnp.float32)}
+    state = tx.init(params)
+    found = [
+        l.dtype for l in jax.tree.leaves(state)
+        if hasattr(l, "dtype") and l.dtype == jnp.bfloat16
+    ]
+    assert found, "no bf16 leaves in opt state"
+    grads = {"w": jnp.ones((4, 4), jnp.float32)}
+    updates, state = tx.update(grads, state, params)
+    params = optax.apply_updates(params, updates)
+    assert all(jnp.isfinite(l).all() for l in jax.tree.leaves(params))
