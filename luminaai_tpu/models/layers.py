@@ -212,7 +212,13 @@ class GQAttention(nn.Module):
         k = jnp.einsum("bsd,dhk->bshk", x, wk.astype(self.dtype))
         v = jnp.einsum("bsd,dhk->bshk", x, wv.astype(self.dtype))
 
-        max_len = kv_cache[0].shape[1] if kv_cache is not None else cfg.seq_length
+        # Runtime length can exceed cfg.seq_length (soft-prompt prefixes
+        # prepend virtual tokens); the rope table covers whichever is larger.
+        max_len = (
+            kv_cache[0].shape[1]
+            if kv_cache is not None
+            else max(cfg.seq_length, S)
+        )
         cos, sin = rope_frequencies(d, max_len, cfg.rope_theta)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)

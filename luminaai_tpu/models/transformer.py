@@ -232,10 +232,26 @@ class LuminaTransformer(nn.Module):
         cache_index: Optional[jax.Array] = None,
         deterministic: bool = True,
         return_hidden: bool = False,
+        prefix_embeds: Optional[jax.Array] = None,
     ):
         cfg = self.config
         embedder = Embedder(cfg, dtype=self.dtype, name="embedder")
         x = embedder.encode(input_ids)
+        n_prefix = 0
+        if prefix_embeds is not None:
+            # Soft-prompt tuning (training/adapters.py): [B, P, H] virtual
+            # tokens prepended before the blocks; the prefix positions are
+            # stripped again after final_norm, so outputs cover only real
+            # tokens. RoPE/causality shift consistently with the longer
+            # sequence. The prefix gets the same stable-embedding scale as
+            # real tokens — init_soft_prompt samples raw table rows.
+            n_prefix = prefix_embeds.shape[1]
+            prefix = prefix_embeds.astype(x.dtype)
+            if cfg.use_stable_embedding:
+                prefix = prefix * jnp.sqrt(float(cfg.hidden_size)).astype(
+                    x.dtype
+                )
+            x = jnp.concatenate([prefix, x], axis=1)
         x = nn.with_logical_constraint(
             x, ("activation_batch", "activation_length", "activation_embed")
         )
@@ -290,6 +306,10 @@ class LuminaTransformer(nn.Module):
                     all_metrics.append(metrics)
 
         x = RMSNorm(cfg.rms_norm_eps, dtype=self.dtype, name="final_norm")(x)
+        if n_prefix:
+            # Strip virtual-token positions before the vocab matmul — the
+            # [B, P, V] logits would be computed only to be discarded.
+            x = x[:, n_prefix:]
         if return_hidden:
             # Caller fuses the LM head into the loss (ops/fused.py
             # fused_lm_head_cross_entropy) — full [B,S,V] logits never exist.

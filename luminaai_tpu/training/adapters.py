@@ -1,0 +1,304 @@
+"""Parameter-efficient fine-tuning: LoRA adapters + soft-prompt tuning.
+
+The reference ships an adapter programme (ref: docs/adapters.md — LoRA and
+prompt-tuning adapter types, sizes, training recipes) whose implementation
+lives in its vendored ColossalAI tree (coati/models/lora.py), which SURVEY §1
+excludes from re-vendoring. This module provides the TPU-native equivalent:
+
+  - LoRA: rank-r deltas on the attention/FFN projection kernels. The base
+    model stays frozen (no optimizer state for it — the actual PEFT memory
+    win: Adam moments exist only for the ~0.1-1% adapter params); the train
+    step merges `W + (alpha/r)·A@B` at use, which XLA fuses into the
+    existing matmuls' epilogue. Works with every dispatch/remat/sharding
+    mode because it is pure parameter surgery — the model code is untouched.
+  - Soft prompts: trainable virtual-token embeddings prepended to the
+    input sequence (prompt tuning; ref adapters.md §2).
+
+Layout notes (why `_split_axis` exists): kernels here are stored in their
+einsum-native shapes — wq [H, nq, d] contracts its FIRST axis with the
+activations, attention wo [nq, d, H] produces its LAST axis — so the
+low-rank factorization must split the kernel at the in/out boundary, not
+blindly at axis 1. MoE expert kernels carry a leading E batch axis and get
+per-expert factors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import re
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from luminaai_tpu.config import Config
+
+# param-name → how to factorize, given the path context.
+_TARGET_NAMES = ("wq", "wk", "wv", "wo", "wi")
+
+
+@dataclasses.dataclass
+class LoRASpec:
+    """What to adapt and how (ref docs/adapters.md "LoRA Adapters")."""
+
+    rank: int = 8
+    alpha: float = 16.0
+    # Regexes matched against the '/'-joined param path. Defaults adapt
+    # attention + dense FFN projections; add 'moe' to adapt expert FFNs
+    # (per-expert factors — rank·E params per kernel).
+    target_patterns: Tuple[str, ...] = (r"attention/", r"ffn/")
+
+    def scaling(self) -> float:
+        return self.alpha / max(self.rank, 1)
+
+
+def _path_str(path) -> str:
+    out = "/".join(str(getattr(k, "key", k)) for k in path)
+    # flax Partitioned boxes flatten with a trailing '.value' path entry.
+    return out[: -len("/.value")] if out.endswith("/.value") else out
+
+
+def _split_axis(path_s: str, name: str, ndim: int) -> Optional[Tuple[int, int]]:
+    """(batch_axes, split) for a target kernel; None if not factorizable.
+
+    split separates contracting-in dims from produced-out dims; batch_axes
+    is the count of leading per-expert axes (MoE kernels).
+    """
+    batch = 1 if "/moe/" in f"/{path_s}/" and ndim == 3 else 0
+    eff = ndim - batch
+    if eff < 2:
+        return None
+    if name in ("wq", "wk", "wv", "wi"):
+        return batch, batch + 1  # in = first effective axis
+    if name == "wo":
+        return batch, ndim - 1  # out = last axis
+    return None
+
+
+def _is_target(path_s: str, name: str, spec: LoRASpec) -> bool:
+    if name not in _TARGET_NAMES:
+        return False
+    return any(re.search(p, path_s) for p in spec.target_patterns)
+
+
+def init_lora_params(
+    params: Dict[str, Any], spec: LoRASpec, rng: jax.Array
+) -> Dict[str, Any]:
+    """Build the adapter tree: {path: {'a': [..., m, r], 'b': [..., r, n]}}.
+
+    a ~ N(0, 1/r), b = 0 — the standard init: the adapted model starts
+    exactly equal to the base model.
+    """
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    lora: Dict[str, Any] = {}
+    for i, (path, leaf) in enumerate(flat):
+        path_s = _path_str(path)
+        name = path_s.rsplit("/", 1)[-1]
+        if not _is_target(path_s, name, spec):
+            continue
+        ax = _split_axis(path_s, name, leaf.ndim)
+        if ax is None:
+            continue
+        batch, split = ax
+        shape = leaf.shape
+        m = int(np.prod(shape[batch:split]))
+        n = int(np.prod(shape[split:]))
+        lead = shape[:batch]
+        k = jax.random.fold_in(rng, i)
+        lora[path_s] = {
+            "a": jax.random.normal(k, (*lead, m, spec.rank), jnp.float32)
+            / np.sqrt(spec.rank),
+            "b": jnp.zeros((*lead, spec.rank, n), jnp.float32),
+        }
+    if not lora:
+        raise ValueError(
+            f"no LoRA targets matched patterns {spec.target_patterns}"
+        )
+    return lora
+
+
+def lora_param_count(lora: Dict[str, Any]) -> int:
+    return sum(p.size for p in jax.tree.leaves(lora))
+
+
+def merge_lora(
+    params: Dict[str, Any], lora: Dict[str, Any], spec: LoRASpec
+) -> Dict[str, Any]:
+    """params with `W + scaling·(A@B)` substituted at every adapted kernel.
+
+    Pure function of both trees — under jit the delta matmul + add fuse
+    into the consumer; call once outside jit to export a merged checkpoint
+    (ref adapters.md "Release": shipping a merged model).
+    """
+    scale = spec.scaling()
+
+    def walk(tree, prefix=()):
+        out = {}
+        for key, val in tree.items():
+            path = (*prefix, key)
+            path_s = "/".join(path)
+            if isinstance(val, dict):
+                out[key] = walk(val, path)
+            elif path_s in lora:
+                ab = lora[path_s]
+                delta = jnp.matmul(ab["a"], ab["b"]) * scale
+                raw = val.unbox() if hasattr(val, "unbox") else val
+                new = (raw + delta.reshape(raw.shape)).astype(raw.dtype)
+                out[key] = (
+                    val.replace_boxed(new)
+                    if hasattr(val, "replace_boxed")
+                    else new
+                )
+            else:
+                out[key] = val
+        return out
+
+    return walk(params)
+
+
+def make_lora_train_step(
+    config: Config,
+    model,
+    base_params: Dict[str, Any],
+    spec: LoRASpec,
+    tx,
+    loss_fn=None,
+):
+    """Jitted PEFT step: grads/optimizer state for the adapter tree only.
+
+    base_params are closed over as a frozen constant (donated nothing;
+    XLA keeps one copy in HBM). Returns step((lora, opt_state), batch) →
+    ((lora, opt_state), metrics).
+    """
+    import optax
+
+    from luminaai_tpu.parallel.train_step import make_loss_fn
+
+    inner = loss_fn or make_loss_fn(config, model)
+
+    def lora_loss(lora, batch, rng):
+        merged = merge_lora(base_params, lora, spec)
+        return inner(merged, batch, rng)
+
+    @jax.jit
+    def step(carry, batch, rng):
+        lora, opt_state = carry
+        (loss, metrics), grads = jax.value_and_grad(lora_loss, has_aux=True)(
+            lora, batch, rng
+        )
+        updates, opt_state = tx.update(grads, opt_state, lora)
+        lora = optax.apply_updates(lora, updates)
+        return (lora, opt_state), metrics
+
+    return step
+
+
+def save_lora(path: str, lora: Dict[str, Any], spec: LoRASpec) -> None:
+    """Adapter checkpoint: one .npz + spec json (1-50MB per ref
+    adapters.md — small enough that orbax machinery is overkill)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    base = path[: -len(".npz")] if path.endswith(".npz") else path
+    flat = {
+        f"{k}::{sub}": np.asarray(v)
+        for k, ab in lora.items()
+        for sub, v in ab.items()
+    }
+    np.savez(base + ".npz", **flat)
+    with open(base + ".json", "w") as f:
+        json.dump(dataclasses.asdict(spec), f)
+
+
+def load_lora(path: str) -> Tuple[Dict[str, Any], LoRASpec]:
+    base = path[: -len(".npz")] if path.endswith(".npz") else path
+    data = np.load(base + ".npz")
+    lora: Dict[str, Any] = {}
+    for key in data.files:
+        k, sub = key.rsplit("::", 1)
+        lora.setdefault(k, {})[sub] = jnp.asarray(data[key])
+    with open(base + ".json") as f:
+        raw = json.load(f)
+    raw["target_patterns"] = tuple(raw["target_patterns"])
+    return lora, LoRASpec(**raw)
+
+
+# ---------------------------------------------------------------------------
+# Soft-prompt tuning (ref adapters.md "Prompt Tuning Adapters")
+# ---------------------------------------------------------------------------
+def init_soft_prompt(
+    params: Dict[str, Any], num_tokens: int, rng: jax.Array
+) -> jax.Array:
+    """[P, H] virtual-token embeddings, initialized from random real rows of
+    the embedding table (the standard warm init — random rows are in the
+    distribution the first layer expects)."""
+    table = params["embedder"]["embedding"]
+    if hasattr(table, "unbox"):
+        table = table.unbox()
+    idx = jax.random.randint(rng, (num_tokens,), 0, table.shape[0])
+    return jnp.asarray(table)[idx]
+
+
+def prepend_soft_prompt(
+    model, params: Dict[str, Any], prompt: jax.Array, input_ids: jax.Array
+):
+    """Forward pass with the soft prompt prepended.
+
+    Returns logits for the real tokens only ([B, S, V] — the model strips
+    the virtual-token positions before its vocab matmul), so callers' loss
+    masks line up unchanged.
+    """
+    cfg = model.config
+    B, S = input_ids.shape
+    P = prompt.shape[0]
+    if cfg.use_flash_attention:
+        from luminaai_tpu.ops.flash_attention import flash_eligible
+
+        if not flash_eligible(
+            S + P, cfg.head_dim(), cfg.flash_block_q, cfg.flash_block_kv
+        ):
+            logging.getLogger(__name__).warning(
+                "soft prompt of %d tokens makes seq %d flash-ineligible "
+                "(blocks %d/%d) — attention falls back to the O(S^2) XLA "
+                "path; pick P so S+P divides the flash blocks",
+                P, S + P, cfg.flash_block_q, cfg.flash_block_kv,
+            )
+    logits, aux = model.apply(
+        {"params": params}, input_ids, prefix_embeds=prompt[None].repeat(B, 0)
+    )
+    return logits, aux
+
+
+def make_prompt_tuning_step(config: Config, model, base_params, tx):
+    """Jitted step training only the [P, H] prompt tensor."""
+    import optax
+
+    from luminaai_tpu.ops.fused import cross_entropy_loss
+    from luminaai_tpu.parallel.train_step import (
+        _shifted_mask_weights,
+        shift_labels,
+    )
+
+    def loss_fn(prompt, batch):
+        logits, aux = prepend_soft_prompt(
+            model, base_params, prompt, batch["input_ids"]
+        )
+        labels, valid = shift_labels(batch)
+        mask, weights = _shifted_mask_weights(batch, valid)
+        loss, metrics = cross_entropy_loss(logits, labels, mask, weights)
+        metrics["loss"] = loss + aux.get("aux_loss", 0.0)
+        return metrics["loss"], metrics
+
+    @jax.jit
+    def step(carry, batch):
+        prompt, opt_state = carry
+        (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            carry[0], batch
+        )
+        updates, opt_state = tx.update(grads, opt_state, prompt)
+        prompt = optax.apply_updates(prompt, updates)
+        return (prompt, opt_state), metrics
+
+    return step

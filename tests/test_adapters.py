@@ -1,0 +1,155 @@
+"""PEFT: LoRA adapters + soft-prompt tuning (ref docs/adapters.md)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from luminaai_tpu.config import Config
+from luminaai_tpu.models.transformer import LuminaTransformer
+from luminaai_tpu.training.adapters import (
+    LoRASpec,
+    init_lora_params,
+    init_soft_prompt,
+    load_lora,
+    lora_param_count,
+    make_lora_train_step,
+    make_prompt_tuning_step,
+    merge_lora,
+    prepend_soft_prompt,
+    save_lora,
+)
+
+
+def tiny_config(**kw) -> Config:
+    base = dict(
+        vocab_size=256,
+        hidden_size=64,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        seq_length=64,
+        intermediate_size=128,
+        use_flash_attention=False,
+        gradient_checkpointing=False,
+        precision="fp32",
+        routing_noise_std=0.0,
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_config()
+    model = LuminaTransformer(cfg)
+    ids = jnp.asarray(
+        np.random.RandomState(0).randint(1, 256, (2, cfg.seq_length)),
+        jnp.int32,
+    )
+    params = model.init(jax.random.key(0), ids)["params"]
+    return cfg, model, params, ids
+
+
+class TestLoRA:
+    def test_zero_init_is_identity(self, setup):
+        cfg, model, params, ids = setup
+        spec = LoRASpec(rank=4)
+        lora = init_lora_params(params, spec, jax.random.key(1))
+        merged = merge_lora(params, lora, spec)
+        base_out, _ = model.apply({"params": params}, ids)
+        lora_out, _ = model.apply({"params": merged}, ids)
+        np.testing.assert_allclose(
+            np.asarray(base_out), np.asarray(lora_out), atol=1e-6
+        )
+
+    def test_param_count_is_small(self, setup):
+        cfg, model, params, ids = setup
+        lora = init_lora_params(params, LoRASpec(rank=4), jax.random.key(1))
+        total = sum(p.size for p in jax.tree.leaves(params))
+        assert lora_param_count(lora) < 0.1 * total
+
+    def test_targets_cover_attention_and_ffn(self, setup):
+        cfg, model, params, ids = setup
+        lora = init_lora_params(params, LoRASpec(rank=4), jax.random.key(1))
+        paths = list(lora)
+        assert any("attention/wq" in p for p in paths)
+        assert any("attention/wo" in p for p in paths)
+        assert any("ffn/wi" in p for p in paths)
+
+    def test_moe_experts_optional(self):
+        cfg = tiny_config(use_moe=True, num_experts=4, moe_top_k=2)
+        model = LuminaTransformer(cfg)
+        ids = jnp.ones((1, cfg.seq_length), jnp.int32)
+        params = model.init(jax.random.key(0), ids)["params"]
+        spec = LoRASpec(rank=2, target_patterns=(r"attention/", r"moe/"))
+        lora = init_lora_params(params, spec, jax.random.key(1))
+        moe_paths = [p for p in lora if "/moe/" in p]
+        assert moe_paths, "expert kernels not matched"
+        # per-expert factors carry the leading E axis
+        a = lora[moe_paths[0]]["a"]
+        assert a.ndim == 3 and a.shape[0] == cfg.num_experts
+        merged = merge_lora(params, lora, spec)
+        out, _ = model.apply({"params": merged}, ids)
+        assert jnp.isfinite(out).all()
+
+    def test_training_moves_loss_base_frozen(self, setup):
+        cfg, model, params, ids = setup
+        spec = LoRASpec(rank=4, alpha=8.0)
+        lora = init_lora_params(params, spec, jax.random.key(1))
+        tx = optax.adam(1e-2)
+        step = make_lora_train_step(cfg, model, params, spec, tx)
+        carry = (lora, tx.init(lora))
+        batch = {"input_ids": ids}
+        losses = []
+        for i in range(10):
+            carry, metrics = step(carry, batch, jax.random.key(i))
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0], losses
+        # b started at zero and must have moved
+        moved = any(
+            float(jnp.abs(ab["b"]).max()) > 0 for ab in carry[0].values()
+        )
+        assert moved
+
+    def test_save_load_roundtrip(self, setup, tmp_path):
+        cfg, model, params, ids = setup
+        spec = LoRASpec(rank=4, alpha=32.0)
+        lora = init_lora_params(params, spec, jax.random.key(1))
+        path = str(tmp_path / "adapter.npz")
+        save_lora(path, lora, spec)
+        lora2, spec2 = load_lora(path)
+        assert spec2 == spec
+        for k in lora:
+            for sub in ("a", "b"):
+                np.testing.assert_array_equal(
+                    np.asarray(lora[k][sub]), np.asarray(lora2[k][sub])
+                )
+
+
+class TestSoftPrompt:
+    def test_prepend_shapes_and_identity_of_suffix(self, setup):
+        cfg, model, params, ids = setup
+        prompt = init_soft_prompt({"embedder": params["embedder"]}, 8,
+                                  jax.random.key(2))
+        assert prompt.shape == (8, cfg.hidden_size)
+        logits, _ = prepend_soft_prompt(model, params, prompt, ids)
+        assert logits.shape == (ids.shape[0], ids.shape[1], cfg.vocab_size)
+
+    def test_prompt_tuning_reduces_loss(self, setup):
+        cfg, model, params, ids = setup
+        prompt = init_soft_prompt({"embedder": params["embedder"]}, 4,
+                                  jax.random.key(2))
+        tx = optax.adam(5e-2)
+        step = make_prompt_tuning_step(cfg, model, params, tx)
+        carry = (prompt, tx.init(prompt))
+        batch = {"input_ids": ids}
+        losses = []
+        for _ in range(10):
+            carry, metrics = step(carry, batch)
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0], losses
+        assert not np.allclose(np.asarray(carry[0]), np.asarray(prompt))
