@@ -268,6 +268,7 @@ def cmd_chat(args) -> int:
     chat = ChatInterface(
         checkpoint_dir=args.checkpoint,
         quantize=getattr(args, "quantize", None),
+        adapter=getattr(args, "adapter", None),
     )
     if chat.engine.quantization_info:
         q = chat.engine.quantization_info
@@ -491,6 +492,106 @@ def cmd_evaluate(args) -> int:
         "batches": n_batches,
     }
     print(json.dumps(result, indent=2))
+    return 0
+
+
+def cmd_finetune(args) -> int:
+    """LoRA fine-tuning against a frozen base checkpoint (docs/adapters.md;
+    ref adapter programme). Optimizer state exists only for the adapter."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from luminaai_tpu.data.dataset import (
+        ConversationDataset,
+        conversation_batches,
+    )
+    from luminaai_tpu.data.tokenizer import ConversationTokenizer
+    from luminaai_tpu.inference.chat import load_model_for_inference
+    from luminaai_tpu.training.adapters import (
+        LoRASpec,
+        init_lora_params,
+        lora_param_count,
+        make_lora_train_step,
+        merge_lora,
+        save_lora,
+    )
+
+    model, params, cfg = load_model_for_inference(args.checkpoint)
+    if args.batch_size:
+        cfg.batch_size = args.batch_size
+    patterns = [r"attention/", r"ffn/"]
+    if args.adapt_experts:
+        patterns.append(r"moe/")
+    spec = LoRASpec(
+        rank=args.rank, alpha=args.alpha, target_patterns=tuple(patterns)
+    )
+    rng = jax.random.key(cfg.seed)
+    lora = init_lora_params(params, spec, rng)
+    base_n = cfg.estimate_parameters()
+    print(
+        f"adapter: rank {spec.rank}, {lora_param_count(lora) / 1e6:.2f}M "
+        f"params ({lora_param_count(lora) / max(base_n, 1):.3%} of base, "
+        f"{len(lora)} kernels)"
+    )
+
+    tx = optax.adam(args.lr)
+    step = make_lora_train_step(cfg, model, params, spec, tx)
+    carry = (lora, tx.init(lora))
+
+    tokenizer = ConversationTokenizer(
+        assistant_loss_weight=cfg.assistant_loss_weight
+    )
+    ds = ConversationDataset(args.data, tokenizer, cfg, split="train")
+    done = 0
+    last = float("nan")
+    while done < args.steps:
+        made_progress = False
+        for batch in conversation_batches(ds, cfg.batch_size, seed=done):
+            if done >= args.steps:
+                break
+            made_progress = True
+            carry, metrics = step(
+                carry,
+                {k: jnp.asarray(v) for k, v in batch.items()},
+                jax.random.fold_in(rng, done),
+            )
+            done += 1
+            if done % max(1, args.steps // 10) == 0 or done == 1:
+                last = float(metrics["loss"])
+                print(f"step {done}/{args.steps} loss {last:.4f}")
+        if not made_progress:
+            print(
+                f"no batches: dataset has fewer than batch_size="
+                f"{cfg.batch_size} usable samples (pass --batch-size)",
+                file=sys.stderr,
+            )
+            return 1
+
+    out = Path(args.out)
+    out.mkdir(parents=True, exist_ok=True)
+    save_lora(str(out / "adapter"), carry[0], spec)
+    print(f"adapter saved: {out / 'adapter'}.npz (final loss {last:.4f})")
+
+    if args.merge_out:
+        import orbax.checkpoint as ocp
+
+        merged = merge_lora(params, carry[0], spec)
+        mout = Path(args.merge_out).absolute()
+        mout.mkdir(parents=True, exist_ok=True)
+        with ocp.CheckpointManager(mout) as mngr:
+            mngr.save(
+                0,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardSave({"params": merged}),
+                    metadata=ocp.args.JsonSave(
+                        {"step": 0, "config": cfg.to_dict(),
+                         "adapter": str(out / "adapter")}
+                    ),
+                ),
+            )
+            mngr.wait_until_finished()
+        print(f"merged checkpoint: {mout}")
     return 0
 
 
@@ -729,7 +830,26 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--password")
     c.add_argument("--quantize", choices=["int8", "int4"],
                    help="weight-only quantization for serving")
+    c.add_argument("--adapter",
+                   help="LoRA adapter (.npz from finetune) merged at load")
     c.set_defaults(fn=cmd_chat)
+
+    ft = sub.add_parser(
+        "finetune", help="LoRA fine-tune against a frozen base checkpoint"
+    )
+    ft.add_argument("--checkpoint", required=True, help="base checkpoint dir")
+    ft.add_argument("--data", required=True, help="jsonl conversations")
+    ft.add_argument("--out", required=True, help="adapter output dir")
+    ft.add_argument("--rank", type=int, default=8)
+    ft.add_argument("--alpha", type=float, default=16.0)
+    ft.add_argument("--lr", type=float, default=1e-4)
+    ft.add_argument("--steps", type=int, default=100)
+    ft.add_argument("--batch-size", dest="batch_size", type=int)
+    ft.add_argument("--adapt-experts", action="store_true",
+                    help="also adapt MoE expert kernels (per-expert factors)")
+    ft.add_argument("--merge-out", dest="merge_out",
+                    help="also export base+adapter as a merged checkpoint")
+    ft.set_defaults(fn=cmd_finetune)
 
     b = sub.add_parser("benchmark", help="run the bench harness")
     b.add_argument("--ops", action="store_true",

@@ -156,6 +156,7 @@ class ChatInterface:
         tokenizer: Optional[ConversationTokenizer] = None,
         engine: Optional[GenerationEngine] = None,
         quantize: Optional[str] = None,
+        adapter: Optional[str] = None,
     ):
         if engine is not None:
             self.engine = engine
@@ -172,6 +173,21 @@ class ChatInterface:
             model, params, config = load_model_for_inference(
                 checkpoint_dir, config=config
             )
+            if adapter is not None:
+                # Serve base + LoRA merged (training/adapters.py; ref
+                # docs/adapters.md "switch behaviors without maintaining
+                # multiple full models").
+                from luminaai_tpu.training.adapters import (
+                    load_lora,
+                    merge_lora,
+                )
+
+                lora, spec = load_lora(adapter)
+                params = merge_lora(params, lora, spec)
+                logger.info(
+                    "merged LoRA adapter %s (rank %d, %d kernels)",
+                    adapter, spec.rank, len(lora),
+                )
             if quantize is not None:
                 # Serve int8/int4 weight-only (the engine applies it from
                 # config; ref trainer.py:575 QuantizationManager).

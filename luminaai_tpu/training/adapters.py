@@ -63,19 +63,23 @@ def _path_str(path) -> str:
 
 
 def _split_axis(path_s: str, name: str, ndim: int) -> Optional[Tuple[int, int]]:
-    """(batch_axes, split) for a target kernel; None if not factorizable.
+    """(lead_axes, split) for a target kernel; None if not factorizable.
 
-    split separates contracting-in dims from produced-out dims; batch_axes
-    is the count of leading per-expert axes (MoE kernels).
+    split separates contracting-in dims from produced-out dims. lead_axes
+    counts leading batch-like axes that get independent factors: the MoE
+    expert axis, and/or the stacked layer axis of scan_layers=True params
+    (nn.scan's variable_axes adds a leading L — transformer.py). The core
+    kernel is 3D for attention (wq [H, nq, d], wo [nq, d, H]) and 2D for
+    FFN/expert kernels (wi [H, 2F], wo [F, H]); anything in front is lead.
     """
-    batch = 1 if "/moe/" in f"/{path_s}/" and ndim == 3 else 0
-    eff = ndim - batch
-    if eff < 2:
+    core = 3 if "/attention/" in f"/{path_s}/" else 2
+    lead = ndim - core
+    if lead < 0 or ndim < 2:
         return None
     if name in ("wq", "wk", "wv", "wi"):
-        return batch, batch + 1  # in = first effective axis
+        return lead, lead + 1  # in = first core axis
     if name == "wo":
-        return batch, ndim - 1  # out = last axis
+        return lead, ndim - 1  # out = last axis
     return None
 
 
@@ -103,11 +107,11 @@ def init_lora_params(
         ax = _split_axis(path_s, name, leaf.ndim)
         if ax is None:
             continue
-        batch, split = ax
+        n_lead, split = ax
         shape = leaf.shape
-        m = int(np.prod(shape[batch:split]))
+        m = int(np.prod(shape[n_lead:split]))
         n = int(np.prod(shape[split:]))
-        lead = shape[:batch]
+        lead = shape[:n_lead]
         k = jax.random.fold_in(rng, i)
         lora[path_s] = {
             "a": jax.random.normal(k, (*lead, m, spec.rank), jnp.float32)
@@ -135,6 +139,7 @@ def merge_lora(
     (ref adapters.md "Release": shipping a merged model).
     """
     scale = spec.scaling()
+    consumed = set()
 
     def walk(tree, prefix=()):
         out = {}
@@ -144,6 +149,7 @@ def merge_lora(
             if isinstance(val, dict):
                 out[key] = walk(val, path)
             elif path_s in lora:
+                consumed.add(path_s)
                 ab = lora[path_s]
                 delta = jnp.matmul(ab["a"], ab["b"]) * scale
                 raw = val.unbox() if hasattr(val, "unbox") else val
@@ -157,7 +163,15 @@ def merge_lora(
                 out[key] = val
         return out
 
-    return walk(params)
+    out = walk(params)
+    missing = set(lora) - consumed
+    if missing:
+        raise ValueError(
+            "adapter does not match this parameter tree (wrong model or "
+            f"layer layout?): unmatched keys {sorted(missing)[:4]}"
+            f"{' ...' if len(missing) > 4 else ''}"
+        )
+    return out
 
 
 def make_lora_train_step(

@@ -153,3 +153,42 @@ class TestSoftPrompt:
             losses.append(float(metrics["loss"]))
         assert losses[-1] < losses[0], losses
         assert not np.allclose(np.asarray(carry[0]), np.asarray(prompt))
+
+
+class TestScannedLayout:
+    def test_lora_on_scanned_params(self):
+        """scan_layers=True params carry a leading L axis (nn.scan
+        variable_axes) — factors must split at the true in/out boundary,
+        with per-layer lead dims, and zero-init must stay an identity."""
+        cfg = tiny_config(scan_layers=True, num_layers=4)
+        model = LuminaTransformer(cfg)
+        ids = jnp.asarray(
+            np.random.RandomState(0).randint(1, 256, (2, cfg.seq_length)),
+            jnp.int32,
+        )
+        params = model.init(jax.random.key(0), ids)["params"]
+        spec = LoRASpec(rank=4)
+        lora = init_lora_params(params, spec, jax.random.key(1))
+        # factors carry the scan-layer lead axis; adapter stays small
+        wq_key = next(p for p in lora if p.endswith("attention/wq"))
+        assert lora[wq_key]["a"].shape[0] == cfg.num_layers
+        assert lora[wq_key]["a"].shape[1:] == (cfg.hidden_size, 4)
+        total = sum(p.size for p in jax.tree.leaves(params))
+        assert lora_param_count(lora) < 0.15 * total
+        merged = merge_lora(params, lora, spec)
+        base_out, _ = model.apply({"params": params}, ids)
+        lora_out, _ = model.apply({"params": merged}, ids)
+        np.testing.assert_allclose(
+            np.asarray(base_out), np.asarray(lora_out), atol=1e-6
+        )
+
+    def test_mismatched_adapter_rejected(self):
+        cfg = tiny_config()
+        model = LuminaTransformer(cfg)
+        ids = jnp.ones((1, cfg.seq_length), jnp.int32)
+        params = model.init(jax.random.key(0), ids)["params"]
+        spec = LoRASpec(rank=2)
+        lora = init_lora_params(params, spec, jax.random.key(1))
+        bogus = {f"nonexistent/{k}": v for k, v in lora.items()}
+        with pytest.raises(ValueError, match="does not match"):
+            merge_lora(params, bogus, spec)

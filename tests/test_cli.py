@@ -254,3 +254,38 @@ def test_train_writes_experiment_metadata(tmp_path, capsys):
     assert "estimated training time" in captured
     meta = json.loads((out_dir / "experiment_metadata.json").read_text())
     assert meta["planned_steps"] == 2 and meta["total_params"] > 0
+
+
+def test_finetune_adapter_and_chat(tmp_path, capsys):
+    """PEFT flow: base train -> LoRA finetune -> chat with --adapter
+    (docs/adapters.md; training/adapters.py)."""
+    out_dir = str(tmp_path / "base")
+    assert run_cli([
+        "train", "--preset", "debug", "--synthetic", "--precision", "fp32",
+        "--no-flash", "--lr", "1e-3", "--batch-size", "8",
+        "--output-dir", out_dir, "--quiet", "--no-adaptive", "--steps", "4",
+    ]) == 0
+
+    data = tmp_path / "ft.jsonl"
+    assert run_cli(["data", "sample", "--out", str(data), "--count", "24"]) == 0
+
+    adapter_dir = str(tmp_path / "adapter")
+    capsys.readouterr()
+    assert run_cli([
+        "finetune", "--checkpoint", f"{out_dir}/checkpoints",
+        "--data", str(data), "--out", adapter_dir,
+        "--rank", "4", "--steps", "3", "--batch-size", "4",
+        "--merge-out", str(tmp_path / "merged"),
+    ]) == 0
+    out = capsys.readouterr().out
+    assert "adapter saved" in out and "merged checkpoint" in out
+    assert (Path(adapter_dir) / "adapter.npz").exists()
+    assert (Path(adapter_dir) / "adapter.json").exists()
+
+    capsys.readouterr()
+    assert run_cli([
+        "chat", "--checkpoint", f"{out_dir}/checkpoints",
+        "--adapter", str(Path(adapter_dir) / "adapter"),
+        "--prompt", "hi", "--max-new-tokens", "4",
+    ]) == 0
+    assert capsys.readouterr().out
