@@ -495,6 +495,35 @@ def cmd_evaluate(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """HTTP chat/completion server (ref Dockerfile.backend: Flask on :5001
+    with /health; here stdlib http.server — luminaai_tpu/serving)."""
+    from luminaai_tpu.serving import serve
+
+    bootstrap = None
+    if args.secure:
+        if bool(args.user) != bool(args.password):
+            print("--secure bootstrap needs BOTH --user and --password",
+                  file=sys.stderr)
+            return 2
+        if args.user:
+            bootstrap = (args.user, args.password)
+        elif not Path("users.json").exists():
+            print("--secure with no --user/--password and no existing "
+                  "users.json: nobody could authenticate", file=sys.stderr)
+            return 2
+    serve(
+        checkpoint=args.checkpoint,
+        host=args.host,
+        port=args.port,
+        secure=args.secure,
+        bootstrap_user=bootstrap,
+        quantize=getattr(args, "quantize", None),
+        adapter=getattr(args, "adapter", None),
+    )
+    return 0
+
+
 def cmd_finetune(args) -> int:
     """LoRA fine-tuning against a frozen base checkpoint (docs/adapters.md;
     ref adapter programme). Optimizer state exists only for the adapter."""
@@ -517,7 +546,12 @@ def cmd_finetune(args) -> int:
         save_lora,
     )
 
-    model, params, cfg = load_model_for_inference(args.checkpoint)
+    # keep_master_dtype: we train against (and may re-export) these
+    # weights; the serving bf16 downcast would permanently round away the
+    # fp32 masters and swallow small LoRA deltas at merge time.
+    model, params, cfg = load_model_for_inference(
+        args.checkpoint, keep_master_dtype=True
+    )
     if args.batch_size:
         cfg.batch_size = args.batch_size
     patterns = [r"attention/", r"ffn/"]
@@ -850,6 +884,18 @@ def build_parser() -> argparse.ArgumentParser:
     ft.add_argument("--merge-out", dest="merge_out",
                     help="also export base+adapter as a merged checkpoint")
     ft.set_defaults(fn=cmd_finetune)
+
+    sv = sub.add_parser("serve", help="HTTP chat/completion server")
+    sv.add_argument("--checkpoint", help="checkpoint dir (auto-discovers)")
+    sv.add_argument("--host", default="127.0.0.1")
+    sv.add_argument("--port", type=int, default=5001)
+    sv.add_argument("--secure", action="store_true",
+                    help="token auth + rate limit + input validation")
+    sv.add_argument("--user", help="bootstrap user (secure mode)")
+    sv.add_argument("--password", help="bootstrap password (secure mode)")
+    sv.add_argument("--quantize", choices=["int8", "int4"])
+    sv.add_argument("--adapter", help="LoRA adapter merged at load")
+    sv.set_defaults(fn=cmd_serve)
 
     b = sub.add_parser("benchmark", help="run the bench harness")
     b.add_argument("--ops", action="store_true",

@@ -80,12 +80,16 @@ def load_model_for_inference(
     checkpoint_dir: str,
     step: Optional[int] = None,
     config: Optional[Config] = None,
+    keep_master_dtype: bool = False,
 ):
     """Restore params (+config) from an orbax checkpoint dir.
 
     Returns (model, params, config). Config priority: explicit arg >
     checkpoint metadata > shape inference from the param tree
     (ref Chat.py:132 load_checkpoint_smart, :219 infer_config).
+    keep_master_dtype=True skips the serving downcast — for consumers that
+    keep training against the weights (LoRA finetune), where bf16-rounding
+    the fp32 masters would be a permanent loss.
     """
     import jax
     import orbax.checkpoint as ocp
@@ -133,7 +137,9 @@ def load_model_for_inference(
     # Serving precision (config.inference_precision, 'auto' → bf16):
     # cast float weights down so the resident serving copy matches the
     # compute dtype instead of keeping fp32 masters around.
-    if "bf16" in config.resolve_precision(for_inference=True):
+    if not keep_master_dtype and "bf16" in config.resolve_precision(
+        for_inference=True
+    ):
         import jax.numpy as jnp
 
         params = jax.tree.map(

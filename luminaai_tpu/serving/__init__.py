@@ -1,0 +1,3 @@
+from luminaai_tpu.serving.server import ChatServer, serve
+
+__all__ = ["ChatServer", "serve"]

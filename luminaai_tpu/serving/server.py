@@ -1,0 +1,284 @@
+"""HTTP serving for chat/completion — stdlib only.
+
+The reference deploys its chat model behind an HTTP backend
+(ref: Dockerfile.backend — Flask server on :5001 with a /health check,
+docker-compose.dev.yml wiring; the Electron desktop app in package.json
+talks to it). This is that surface, TPU-side: a ThreadingHTTPServer wrapping
+GenerationEngine (requests serialize onto the single jit'd decode loop via a
+lock — TPU decode is latency-bound, one stream at a time beats contention),
+with the security stack (auth, rate limiting, input validation) optional on
+the same endpoints.
+
+Endpoints:
+  GET  /health            liveness + model info (ref HEALTHCHECK contract)
+  GET  /stats             session counters
+  POST /v1/generate       {"prompt": str, "max_new_tokens"?, "temperature"?,
+                           "top_p"?, "top_k"?} → {"text", "tokens", ...}
+  POST /v1/chat           {"messages": [{"role","content"},...]} or
+                           {"message": str} → {"reply", ...}
+  POST /v1/auth           {"user","password"} → {"token"} (secure mode)
+
+No flask/fastapi in the image — http.server keeps the component
+dependency-free and testable in-process.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+MAX_BODY_BYTES = 1 << 20  # 1MB request cap (input_validator also re-checks)
+
+
+class ChatServer:
+    """Owns the engine + optional security stack; builds the handler class."""
+
+    def __init__(
+        self,
+        engine,
+        secure: bool = False,
+        bootstrap_user: Optional[tuple] = None,
+        users_path: str = "users.json",
+        max_new_tokens_cap: int = 2048,
+    ):
+        self.engine = engine
+        self.lock = threading.Lock()  # one decode stream at a time
+        # Auth/limiter/counter state is shared across handler threads;
+        # SecurityManager and RateLimiter are not thread-safe themselves.
+        self.state_lock = threading.Lock()
+        self.t0 = time.time()
+        self.requests = 0
+        self.tokens_out = 0
+        self.max_new_tokens_cap = max_new_tokens_cap
+        self.secure = secure
+        self.security = None
+        self.limiter = None
+        self.validator = None
+        if secure:
+            from luminaai_tpu.security.auth import SecurityManager
+            from luminaai_tpu.security.input_validator import InputValidator
+            from luminaai_tpu.security.rate_limiter import RateLimiter
+
+            self.security = SecurityManager(persist_path=users_path)
+            self.limiter = RateLimiter()
+            self.validator = InputValidator()
+            if bootstrap_user is not None:
+                user, password = bootstrap_user
+                self.security.create_user(user, password)
+
+    # -- request handling --------------------------------------------------
+    def handle(self, method: str, path: str, body: Dict[str, Any],
+               token: Optional[str]) -> tuple:
+        """Returns (status_code, payload dict). Pure-ish: no socket I/O."""
+        if method == "GET" and path == "/health":
+            cfg = self.engine.config
+            return 200, {
+                "status": "ok",
+                "uptime_s": round(time.time() - self.t0, 1),
+                "model": {
+                    "hidden_size": cfg.hidden_size,
+                    "num_layers": cfg.num_layers,
+                    "vocab_size": cfg.vocab_size,
+                    "moe": bool(cfg.use_moe),
+                },
+                "secure": self.secure,
+            }
+        if method == "GET" and path == "/stats":
+            return 200, {
+                "requests": self.requests,
+                "tokens_out": self.tokens_out,
+                "uptime_s": round(time.time() - self.t0, 1),
+            }
+        if method == "POST" and path == "/v1/auth":
+            if not self.secure:
+                return 400, {"error": "server not in secure mode"}
+            with self.state_lock:
+                token = self.security.authenticate(
+                    str(body.get("user", "")), str(body.get("password", ""))
+                )
+            if token is None:
+                return 401, {"error": "authentication failed"}
+            return 200, {"token": token}
+        if method == "POST" and path in ("/v1/generate", "/v1/chat"):
+            with self.state_lock:
+                err = self._gate(body, token)
+            if err is not None:
+                return err
+            return self._run_model(path, body)
+        return 404, {"error": f"no route {method} {path}"}
+
+    def _gate(self, body: Dict[str, Any], token: Optional[str]):
+        """Secure-mode checks: session token, rate limit, input validation."""
+        if not self.secure:
+            return None
+        session = self.security.validate_session(token or "")
+        if session is None:
+            return 401, {"error": "missing or invalid token"}
+        user = session.get("username", "anonymous")
+        if not self.limiter.is_allowed(user, "chat"):
+            return 429, {"error": "rate limit exceeded"}
+        text = body.get("prompt") or body.get("message") or ""
+        if not text and body.get("messages"):
+            text = " ".join(
+                str(m.get("content", "")) for m in body["messages"]
+            )
+        verdict = self.validator.validate_user_input(str(text))
+        if not verdict.valid:
+            return 400, {
+                "error": f"input rejected: {'; '.join(verdict.errors)}"
+            }
+        return None
+
+    # (name, clamp) — requests cannot push sampling params outside sane
+    # bounds; max_new_tokens is capped so one request can't hold the decode
+    # lock arbitrarily long (the rate limiter counts requests, not tokens).
+    _OVERRIDE_CLAMPS = {
+        "max_new_tokens": lambda v, cap: max(1, min(int(v), cap)),
+        "temperature": lambda v, _: min(max(float(v), 0.0), 10.0),
+        "top_p": lambda v, _: min(max(float(v), 0.0), 1.0),
+        "top_k": lambda v, _: max(0, min(int(v), 10_000)),
+    }
+
+    def _run_model(self, path: str, body: Dict[str, Any]) -> tuple:
+        cfg = self.engine.config
+        overrides = {}
+        for k, clamp in self._OVERRIDE_CLAMPS.items():
+            if k in body:
+                try:
+                    overrides[k] = clamp(body[k], self.max_new_tokens_cap)
+                except (TypeError, ValueError):
+                    return 400, {"error": f"bad value for {k}"}
+        with self.lock:
+            old = {k: getattr(cfg, k) for k in overrides}
+            for k, v in overrides.items():
+                setattr(cfg, k, v)
+            try:
+                t0 = time.time()
+                if path == "/v1/chat":
+                    messages = body.get("messages")
+                    if not messages:
+                        msg = str(body.get("message", ""))
+                        if not msg:
+                            return 400, {"error": "message(s) required"}
+                        messages = [{"role": "user", "content": msg}]
+                    for m in messages:
+                        if (
+                            not isinstance(m, dict)
+                            or not isinstance(m.get("role"), str)
+                            or not isinstance(m.get("content"), str)
+                        ):
+                            return 400, {
+                                "error": "each message needs string "
+                                         "'role' and 'content'"
+                            }
+                    reply, stats = self.engine.chat_response(messages)
+                    out = {"reply": reply}
+                else:
+                    prompt = str(body.get("prompt", ""))
+                    if not prompt:
+                        return 400, {"error": "prompt required"}
+                    tok = self.engine.tokenizer
+                    tokens, stats = self.engine.generate(
+                        tok.backend.encode(prompt)
+                    )
+                    out = {"text": tok.decode(tokens)}
+            finally:
+                for k, v in old.items():
+                    setattr(cfg, k, v)
+        n_tok = int(stats.get("tokens_generated", 0))
+        with self.state_lock:
+            self.requests += 1
+            self.tokens_out += n_tok
+        out.update(
+            tokens=n_tok,
+            latency_s=round(time.time() - t0, 3),
+            stopped=stats.get("stopped"),
+        )
+        return 200, out
+
+    # -- socket layer ------------------------------------------------------
+    def make_handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # route to logging, not stderr
+                logger.info("%s %s", self.address_string(), fmt % args)
+
+            def _reply(self, code: int, payload: Dict[str, Any]) -> None:
+                data = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _token(self) -> Optional[str]:
+                auth = self.headers.get("Authorization", "")
+                return auth[7:] if auth.startswith("Bearer ") else None
+
+            def do_GET(self):
+                # Health probes often add query strings (cache busting);
+                # route on the bare path.
+                code, payload = server.handle(
+                    "GET", self.path.split("?", 1)[0], {}, self._token()
+                )
+                self._reply(code, payload)
+
+            def do_POST(self):
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    if n > MAX_BODY_BYTES:
+                        self._reply(413, {"error": "body too large"})
+                        return
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    if not isinstance(body, dict):
+                        raise ValueError("body must be a JSON object")
+                except (ValueError, json.JSONDecodeError) as e:
+                    self._reply(400, {"error": f"bad request: {e}"})
+                    return
+                try:
+                    code, payload = server.handle(
+                        "POST", self.path.split("?", 1)[0], body,
+                        self._token(),
+                    )
+                except Exception as e:  # surface as 500, keep serving
+                    logger.exception("request failed")
+                    code, payload = 500, {"error": str(e)}
+                self._reply(code, payload)
+
+        return Handler
+
+    def serve_forever(self, host: str = "127.0.0.1", port: int = 5001):
+        httpd = ThreadingHTTPServer((host, port), self.make_handler())
+        logger.info("serving on http://%s:%d (secure=%s)", host, port,
+                    self.secure)
+        try:
+            httpd.serve_forever()
+        finally:
+            httpd.server_close()
+
+
+def serve(
+    checkpoint: Optional[str] = None,
+    host: str = "127.0.0.1",
+    port: int = 5001,
+    secure: bool = False,
+    bootstrap_user: Optional[tuple] = None,
+    quantize: Optional[str] = None,
+    adapter: Optional[str] = None,
+):
+    """Build an engine from a checkpoint and serve it (CLI `serve`)."""
+    from luminaai_tpu.inference.chat import ChatInterface
+
+    chat = ChatInterface(
+        checkpoint_dir=checkpoint, quantize=quantize, adapter=adapter
+    )
+    ChatServer(
+        chat.engine, secure=secure, bootstrap_user=bootstrap_user
+    ).serve_forever(host, port)

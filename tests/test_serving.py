@@ -1,0 +1,170 @@
+"""HTTP serving surface (ref Dockerfile.backend Flask-on-:5001 contract)."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import ThreadingHTTPServer
+
+import pytest
+
+from luminaai_tpu.config import Config
+from luminaai_tpu.serving.server import ChatServer
+
+
+class FakeTokenizerBackend:
+    def encode(self, text):
+        return [ord(c) % 250 for c in text]
+
+
+class FakeTokenizer:
+    backend = FakeTokenizerBackend()
+
+    def decode(self, tokens):
+        return "tok:" + ",".join(str(t) for t in tokens)
+
+
+class FakeEngine:
+    """Engine double mirroring GenerationEngine's contract: generate()
+    maps token ids -> (token ids, stats); chat_response maps messages ->
+    (text, stats); .tokenizer does the text round-trip."""
+
+    def __init__(self):
+        self.config = Config(
+            vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+            num_kv_heads=2, seq_length=64, use_flash_attention=False,
+        )
+        self.tokenizer = FakeTokenizer()
+
+    def generate(self, prompt_tokens):
+        return list(prompt_tokens)[:3], {
+            "tokens_generated": 3, "stopped": "eos",
+        }
+
+    def chat_response(self, messages):
+        return f"reply to {messages[-1]['content']}", {
+            "tokens_generated": 2, "stopped": "eos",
+        }
+
+
+@pytest.fixture()
+def server_url():
+    srv = ChatServer(FakeEngine())
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), srv.make_handler())
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}", srv
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def _post(url, path, body, token=None):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json",
+                 **({"Authorization": f"Bearer {token}"} if token else {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(url, path):
+    with urllib.request.urlopen(url + path, timeout=10) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_health(server_url):
+    url, _ = server_url
+    code, body = _get(url, "/health")
+    assert code == 200 and body["status"] == "ok"
+    assert body["model"]["hidden_size"] == 64
+
+
+def test_generate_and_stats(server_url):
+    url, srv = server_url
+    code, body = _post(url, "/v1/generate", {"prompt": "hi"})
+    assert code == 200 and body["text"].startswith("tok:")
+    assert body["tokens"] == 3
+    code, body = _post(url, "/v1/chat", {"message": "yo"})
+    assert code == 200 and body["reply"] == "reply to yo"
+    code, body = _get(url, "/stats")
+    assert body["requests"] == 2 and body["tokens_out"] == 5
+
+
+def test_bad_requests(server_url):
+    url, _ = server_url
+    assert _post(url, "/v1/generate", {})[0] == 400
+    assert _post(url, "/nope", {})[0] == 404
+    code, body = _get(url, "/stats")  # GET unknown POST-only route
+    assert code == 200
+
+
+def test_generation_overrides_are_scoped(server_url):
+    url, srv = server_url
+    base = srv.engine.config.max_new_tokens
+    code, _ = _post(url, "/v1/generate",
+                    {"prompt": "x", "max_new_tokens": 7})
+    assert code == 200
+    assert srv.engine.config.max_new_tokens == base  # restored
+
+
+class TestSecure:
+    @pytest.fixture()
+    def secure_url(self, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)  # SecurityManager persists users.json
+        srv = ChatServer(
+            FakeEngine(), secure=True, bootstrap_user=("operator", "hunter22x")
+        )
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), srv.make_handler())
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        yield f"http://127.0.0.1:{httpd.server_address[1]}", srv
+        httpd.shutdown()
+        httpd.server_close()
+
+    def test_auth_flow(self, secure_url):
+        url, _ = secure_url
+        assert _post(url, "/v1/chat", {"message": "hi"})[0] == 401
+        code, body = _post(url, "/v1/auth",
+                           {"user": "operator", "password": "wrong1234"})
+        assert code == 401
+        code, body = _post(url, "/v1/auth",
+                           {"user": "operator", "password": "hunter22x"})
+        assert code == 200 and body["token"]
+        token = body["token"]
+        code, body = _post(url, "/v1/chat", {"message": "hi"}, token=token)
+        assert code == 200 and body["reply"]
+
+    def test_input_validation(self, secure_url):
+        url, _ = secure_url
+        code, body = _post(url, "/v1/auth",
+                           {"user": "operator", "password": "hunter22x"})
+        token = body["token"]
+        code, body = _post(url, "/v1/chat", {"message": "   "}, token=token)
+        assert code == 400
+
+
+def test_override_clamps(server_url):
+    url, srv = server_url
+    code, body = _post(url, "/v1/generate",
+                       {"prompt": "x", "max_new_tokens": 10**9,
+                        "temperature": 99, "top_p": 5})
+    assert code == 200  # clamped, not refused
+    code, body = _post(url, "/v1/generate",
+                       {"prompt": "x", "max_new_tokens": "lots"})
+    assert code == 400
+
+
+def test_health_with_query_string(server_url):
+    url, _ = server_url
+    code, body = _get(url, "/health?probe=1")
+    assert code == 200 and body["status"] == "ok"
+
+
+def test_malformed_chat_messages(server_url):
+    url, _ = server_url
+    code, body = _post(url, "/v1/chat", {"messages": [{"content": "hi"}]})
+    assert code == 400 and "role" in body["error"]
