@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Measure adaptive-orchestrator overhead: steps/s for the bare trainer,
+the orchestrator with interventions disabled (steady-state callback cost),
+and the full adaptive stack, same model/data/steps.
+
+Counterpart to the reference's Preformance_Overhead.md, which gives
+qualitative tiers ("3-8% slowdown on small setups"); here the design is a
+synchronous callback every `health_check_interval` steps (no monitor
+thread, no per-step host sync), so the expected steady-state overhead is
+~0 — this script proves it with numbers (docs/performance_overhead.md).
+
+Usage: [JAX_PLATFORMS=cpu] python scripts/overhead_bench.py [steps]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run(mode: str, steps: int) -> dict:
+    from luminaai_tpu.cli import _synthetic_batches
+    from luminaai_tpu.config import ConfigPresets
+    from luminaai_tpu.training.trainer import Trainer
+
+    cfg = ConfigPresets.debug()
+    cfg.max_steps = steps
+    cfg.learning_rate = 1e-3
+    cfg.output_dir = f"/tmp/overhead_{mode}_{os.getpid()}"
+    cfg.save_every_n_batches = 10**9  # no checkpoint I/O in the window
+    cfg.eval_every_n_batches = 10**9
+    cfg.health_check_interval = 50
+    if mode == "passive":
+        # Callback runs, decisions don't: measures pure observation cost.
+        cfg.enable_adaptive_lr = False
+        cfg.enable_moe_routing_optimization = False
+        cfg.enable_batch_size_optimization = False
+
+    trainer = Trainer(
+        cfg, train_data=_synthetic_batches(cfg, n_batches=steps + 1)
+    )
+    t0 = time.perf_counter()
+    if mode == "bare":
+        summary = trainer.train()
+    else:
+        from luminaai_tpu.training.orchestrator import (
+            AdaptiveTrainingOrchestrator,
+        )
+
+        summary = AdaptiveTrainingOrchestrator(trainer).run(oom_protect=False)
+    dt = time.perf_counter() - t0
+    trainer.close()
+    return {
+        "steps": summary.get("final_step"),
+        "wall_s": round(dt, 2),
+        "steps_per_s": round(summary.get("final_step", 0) / dt, 2),
+        "decisions": [
+            (d["kind"], d["step"])
+            for d in summary.get("adaptive_decisions", [])
+        ],
+    }
+
+
+def main():
+    steps = int(sys.argv[1]) if len(sys.argv) > 1 else 200
+    if steps <= 50:
+        print(
+            "WARNING: steps <= health_check_interval (50): the orchestrator "
+            "never reaches a health check, so the comparison below measures "
+            "nothing but noise. Use >= 150 steps.",
+            file=sys.stderr,
+        )
+    results = {m: run(m, steps) for m in ("bare", "passive", "active")}
+    for mode, r in results.items():
+        print(f"{mode:8s} {r}")
+    base = max(results["bare"]["steps_per_s"], 1e-9)
+    print(
+        f"steady-state overhead (passive): "
+        f"{1.0 - results['passive']['steps_per_s'] / base:+.2%}; "
+        f"full adaptive: {1.0 - results['active']['steps_per_s'] / base:+.2%}"
+        f" (interventions each pay one recompile: "
+        f"{results['active']['decisions']})"
+    )
+
+
+if __name__ == "__main__":
+    main()
