@@ -1,24 +1,35 @@
 #!/usr/bin/env python
 """Measure adaptive-orchestrator overhead: steps/s for the bare trainer,
-the orchestrator with interventions disabled (steady-state callback cost),
-and the full adaptive stack, same model/data/steps.
+the orchestrator with ALL interventions disabled (steady-state callback
+cost), and the full adaptive stack, same model/data/steps.
 
 Counterpart to the reference's Preformance_Overhead.md, which gives
-qualitative tiers ("3-8% slowdown on small setups"); here the design is a
-synchronous callback every `health_check_interval` steps (no monitor
-thread, no per-step host sync), so the expected steady-state overhead is
-~0 — this script proves it with numbers (docs/performance_overhead.md).
+qualitative tiers ("3-8% slowdown on small setups"); here the orchestrator
+is a synchronous callback at the trainer's log cadence
+(health_check_interval/10 steps; decisions are evaluated once per full
+health_check_interval), with no monitor thread and no per-step host sync,
+so the expected steady-state overhead is ~0 — this script proves it with
+numbers (docs/performance_overhead.md).
+
+Each mode runs in its own subprocess so one-time backend init and warmup
+aren't charged to whichever mode happens to run first.
 
 Usage: [JAX_PLATFORMS=cpu] python scripts/overhead_bench.py [steps]
 """
+import json
 import os
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+MODES = ("bare", "passive", "active")
 
 
-def run(mode: str, steps: int) -> dict:
+def run_mode(mode: str, steps: int) -> dict:
+    """Child entry: one timed training run; prints a JSON result line."""
     from luminaai_tpu.cli import _synthetic_batches
     from luminaai_tpu.config import ConfigPresets
     from luminaai_tpu.training.trainer import Trainer
@@ -31,26 +42,36 @@ def run(mode: str, steps: int) -> dict:
     cfg.eval_every_n_batches = 10**9
     cfg.health_check_interval = 50
     if mode == "passive":
-        # Callback runs, decisions don't: measures pure observation cost.
+        # Callback observes, decisions can't fire: pure observation cost.
+        # emergency_override_enabled also gates the anomaly path — without
+        # this an early loss spike could trigger a rollback and corrupt
+        # the steady-state measurement.
         cfg.enable_adaptive_lr = False
         cfg.enable_moe_routing_optimization = False
         cfg.enable_batch_size_optimization = False
+        cfg.enable_architecture_evolution = False
+        cfg.emergency_override_enabled = False
 
     trainer = Trainer(
         cfg, train_data=_synthetic_batches(cfg, n_batches=steps + 1)
     )
-    t0 = time.perf_counter()
-    if mode == "bare":
-        summary = trainer.train()
-    else:
-        from luminaai_tpu.training.orchestrator import (
-            AdaptiveTrainingOrchestrator,
-        )
+    try:
+        t0 = time.perf_counter()
+        if mode == "bare":
+            summary = trainer.train()
+        else:
+            from luminaai_tpu.training.orchestrator import (
+                AdaptiveTrainingOrchestrator,
+            )
 
-        summary = AdaptiveTrainingOrchestrator(trainer).run(oom_protect=False)
-    dt = time.perf_counter() - t0
-    trainer.close()
+            summary = AdaptiveTrainingOrchestrator(trainer).run(
+                oom_protect=False
+            )
+        dt = time.perf_counter() - t0
+    finally:
+        trainer.close()
     return {
+        "mode": mode,
         "steps": summary.get("final_step"),
         "wall_s": round(dt, 2),
         "steps_per_s": round(summary.get("final_step", 0) / dt, 2),
@@ -66,22 +87,38 @@ def main():
     if steps <= 50:
         print(
             "WARNING: steps <= health_check_interval (50): the orchestrator "
-            "never reaches a health check, so the comparison below measures "
-            "nothing but noise. Use >= 150 steps.",
+            "never reaches a decision point, so 'active' measures nothing "
+            "beyond 'passive'. Use >= 150 steps.",
             file=sys.stderr,
         )
-    results = {m: run(m, steps) for m in ("bare", "passive", "active")}
-    for mode, r in results.items():
-        print(f"{mode:8s} {r}")
-    base = max(results["bare"]["steps_per_s"], 1e-9)
-    print(
-        f"steady-state overhead (passive): "
-        f"{1.0 - results['passive']['steps_per_s'] / base:+.2%}; "
-        f"full adaptive: {1.0 - results['active']['steps_per_s'] / base:+.2%}"
-        f" (interventions each pay one recompile: "
-        f"{results['active']['decisions']})"
-    )
+    results = {}
+    for mode in MODES:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--child", mode, str(steps)],
+            capture_output=True, text=True, cwd=REPO, timeout=3600,
+        )
+        if proc.returncode != 0:
+            print(f"{mode} FAILED: {proc.stderr[-500:]}", file=sys.stderr)
+            continue
+        line = proc.stdout.strip().splitlines()[-1]
+        results[mode] = json.loads(line)
+        print(f"{mode:8s} {results[mode]}")
+    if len(results) == len(MODES):
+        base = max(results["bare"]["steps_per_s"], 1e-9)
+        print(
+            f"steady-state overhead (passive): "
+            f"{1.0 - results['passive']['steps_per_s'] / base:+.2%} "
+            f"(decisions: {results['passive']['decisions']}); "
+            f"full adaptive: "
+            f"{1.0 - results['active']['steps_per_s'] / base:+.2%} "
+            f"(each decision pays one recompile: "
+            f"{results['active']['decisions']})"
+        )
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 4 and sys.argv[1] == "--child":
+        print(json.dumps(run_mode(sys.argv[2], int(sys.argv[3]))))
+    else:
+        main()

@@ -163,3 +163,14 @@ def test_ring_flash_gradients_match(heads):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=5e-5, err_msg=f"d{name}"
         )
+
+
+def test_ring_long_context_4k():
+    """Long-context path: 4096-token sequence sharded sp=4 must match the
+    full-sequence reference (the framework's long-context story rides this
+    op — SURVEY §2 item 21, 'ref scale target')."""
+    q, k, v = rand_qkv(B=2, S=4096, Hq=2, Hkv=1, D=16, seed=3)
+    mesh = seq_mesh(4)
+    out = ring_attention(q, k, v, mesh, causal=True)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)

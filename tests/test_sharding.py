@@ -144,3 +144,63 @@ def test_mesh_shape_inference():
     }
     with pytest.raises(ValueError):
         mesh_shape_from_config(tiny_config(tensor_parallel_size=3), 8)
+
+
+def test_all_five_axes_together():
+    """dp2 x fsdp2 x ep2 x sp2 x tp2 on a 32-device virtual mesh: the full
+    parallelism cross-product must jit + run one finite step. Runs in a
+    subprocess because conftest pins this process to 8 devices."""
+    import os
+    import subprocess
+    import sys
+    import textwrap
+
+    code = textwrap.dedent(
+        """
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np, jax.numpy as jnp
+        from tests.test_sharding import make_batch, tiny_config
+        from luminaai_tpu.models.transformer import LuminaTransformer
+        from luminaai_tpu.parallel.mesh import build_mesh
+        from luminaai_tpu.parallel.sharding import init_sharded_state
+        from luminaai_tpu.parallel.train_step import make_train_step
+        from luminaai_tpu.training.optimizer import make_optimizer, make_schedule
+
+        assert jax.device_count() == 32, jax.device_count()
+        cfg = tiny_config(
+            data_parallel_size=2, fsdp_parallel_size=2,
+            expert_parallel_size=2, sequence_parallel_size=2,
+            tensor_parallel_size=2, use_moe=True, num_experts=8,
+            moe_pattern="all", use_ring_attention=True, batch_size=8,
+        )
+        model = LuminaTransformer(cfg)
+        schedule = make_schedule(cfg, 4)
+        tx = make_optimizer(cfg, 4, schedule)
+        mesh = build_mesh(cfg)
+        assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+            "data": 2, "fsdp": 2, "expert": 2, "sequence": 2, "tensor": 2,
+        }
+        state, shardings = init_sharded_state(
+            cfg, model, tx, mesh, jax.random.key(0)
+        )
+        step = make_train_step(cfg, model, shardings, mesh, schedule, tx)
+        state, metrics = step(state, make_batch(cfg))
+        loss = float(metrics["loss"])
+        assert np.isfinite(loss), loss
+        print(f"OK loss={loss:.4f}")
+        """
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=32"
+    import pathlib
+
+    repo = str(pathlib.Path(__file__).resolve().parent.parent)
+    env["PYTHONPATH"] = repo
+    proc = subprocess.run(
+        [sys.executable, "-c", code], env=env, cwd=repo,
+        capture_output=True, text=True, timeout=900,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "OK loss=" in proc.stdout
