@@ -44,6 +44,7 @@ def _apply_overrides(cfg, args) -> None:
         ("output_dir", "output_dir"),
         ("experiment", "experiment_name"),
         ("grad_accum", "gradient_accumulation_steps"),
+        ("tokenizer", "tokenizer_name"),
     ]:
         val = getattr(args, flag, None)
         if val is not None:
@@ -113,8 +114,18 @@ def make_data(cfg, args):
 
     path = data_path
     tokenizer = ConversationTokenizer(
-        assistant_loss_weight=cfg.assistant_loss_weight
+        model_name=cfg.tokenizer_name,
+        assistant_loss_weight=cfg.assistant_loss_weight,
     )
+    if tokenizer.vocab_size > cfg.vocab_size:
+        # A trained vocab larger than the model's embedding table would
+        # index out of range; grow the model to fit (tokenizer.vocab_size
+        # is already 128-aligned).
+        logger.warning(
+            "tokenizer vocab %d > model vocab_size %d; raising model "
+            "vocab_size to match", tokenizer.vocab_size, cfg.vocab_size,
+        )
+        cfg.vocab_size = tokenizer.vocab_size
     if getattr(args, "packed", False):
         cache = build_text_cache(
             path, str(Path(cfg.output_dir) / "cache" / Path(path).stem),
@@ -378,6 +389,41 @@ def cmd_data(args) -> int:
                     "to process a local raw dump", file=sys.stderr,
                 )
                 return 1
+    elif args.action == "train-tokenizer":
+        # Offline BPE vocab training (data/bpe.py; the reference can only
+        # consume pretrained tiktoken vocabs). --in accepts conversation
+        # or plain-text jsonl; --vocab-size is the target vocab.
+        from luminaai_tpu.data.bpe import train_bpe
+
+        def texts():
+            with open(args.inp) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        row = json.loads(line)
+                    except json.JSONDecodeError:
+                        yield line
+                        continue
+                    if isinstance(row, dict) and "messages" in row:
+                        for m in row["messages"]:
+                            yield str(m.get("content", ""))
+                    elif isinstance(row, dict) and "text" in row:
+                        yield str(row["text"])
+                    else:
+                        yield line
+
+        tok = train_bpe(texts(), vocab_size=args.vocab_size)
+        tok.save(args.out)
+        sample = "The quick brown fox jumps over the lazy dog."
+        n_bpe = len(tok.encode(sample))
+        print(
+            f"trained {tok.n_vocab}-token BPE -> {args.out} "
+            f"(sample compression {len(sample.encode()) / max(n_bpe, 1):.2f} "
+            "bytes/token; use with --tokenizer "
+            f"bpe:{args.out})"
+        )
     elif args.action == "oasst":
         n = process_oasst_data(args.inp, args.out)
         print(f"converted {n} conversations -> {args.out}")
@@ -818,6 +864,9 @@ def build_parser() -> argparse.ArgumentParser:
     t = sub.add_parser("train", help="train a model")
     add_config_flags(t)
     t.add_argument("--data", help="jsonl conversations (or text with --packed)")
+    t.add_argument("--tokenizer",
+                   help="tokenizer backend: byte | bpe:PATH | tiktoken:NAME "
+                        "| hf:NAME")
     t.add_argument("--eval-data", dest="eval_data")
     t.add_argument("--packed", action="store_true",
                    help="treat --data as base-training text jsonl")
@@ -838,6 +887,7 @@ def build_parser() -> argparse.ArgumentParser:
     r = sub.add_parser("resume", help="resume training from output dir")
     add_config_flags(r)
     r.add_argument("--data")
+    r.add_argument("--tokenizer")
     r.add_argument("--eval-data", dest="eval_data")
     r.add_argument("--packed", action="store_true")
     r.add_argument("--synthetic", action="store_true")
@@ -904,13 +954,17 @@ def build_parser() -> argparse.ArgumentParser:
 
     d = sub.add_parser("data", help="dataset utilities")
     d.add_argument(
-        "action", choices=["sample", "oasst", "validate", "acquire", "blend"]
+        "action",
+        choices=["sample", "oasst", "validate", "acquire", "blend",
+                 "train-tokenizer"],
     )
     d.add_argument("--sources", nargs="*",
                    help="blend: name=weight=glob triples")
     d.add_argument("--in", dest="inp")
     d.add_argument("--out")
     d.add_argument("--count", type=int, default=100)
+    d.add_argument("--vocab-size", dest="vocab_size", type=int, default=4096,
+                   help="train-tokenizer: target vocab (incl. 256 bytes)")
     d.add_argument("--max-per-file", dest="max_per_file", type=int,
                    default=None,
                    help="acquire: rotate output shards after N conversations "

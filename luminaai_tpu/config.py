@@ -143,7 +143,7 @@ class Config:
     # --- Data ---
     train_data_path: str = "data/train.jsonl"
     eval_data_path: str = "data/eval.jsonl"
-    tokenizer_name: str = "gpt2"
+    tokenizer_name: str = "byte"  # byte|bpe:PATH|tiktoken:NAME|hf:NAME
     num_workers: int = 2
     max_conversations_per_file: int = 10000
     streaming_threshold_gb: float = 10.0

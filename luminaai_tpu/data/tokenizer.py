@@ -84,6 +84,15 @@ def _make_backend(model_name: str):
     unavailable (no egress in this environment)."""
     if model_name in ("byte", "bytes"):
         return _ByteBackend()
+    if model_name.startswith("bpe:"):
+        # Trained-offline byte-level BPE (data/bpe.py; CLI: data
+        # train-tokenizer). The user named a specific local file — a
+        # failure to load it must raise, not silently degrade to bytes
+        # (unlike tiktoken/hf, whose fallback covers missing network
+        # caches).
+        from luminaai_tpu.data.bpe import BPETokenizer
+
+        return BPETokenizer.load(model_name.split(":", 1)[1])
     if model_name.startswith("tiktoken:"):
         try:
             import tiktoken

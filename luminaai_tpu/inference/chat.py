@@ -199,10 +199,11 @@ class ChatInterface:
                 # config; ref trainer.py:575 QuantizationManager).
                 config.quantization_method = quantize
             self.config = config
+            # The checkpoint's tokenizer_name travels in its config
+            # metadata; decoding with anything else (e.g. forcing byte for
+            # a bpe-trained model) would mismatch every id.
             tokenizer = tokenizer or ConversationTokenizer(
                 model_name=config.tokenizer_name
-                if config.tokenizer_name in ("byte",)
-                else "byte"
             )
             self.engine = GenerationEngine(model, params, tokenizer, config)
         self.tokenizer = self.engine.tokenizer

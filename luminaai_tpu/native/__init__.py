@@ -21,6 +21,7 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 _SRC = Path(__file__).parent / "dataloader.cpp"
+_SRC_BPE = Path(__file__).parent / "bpe.cpp"
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
 
@@ -35,13 +36,13 @@ def _cache_dir() -> Path:
 
 
 def _build() -> Optional[ctypes.CDLL]:
-    src = _SRC.read_bytes()
+    src = _SRC.read_bytes() + _SRC_BPE.read_bytes()
     tag = hashlib.sha256(src).hexdigest()[:16]
     so = _cache_dir() / f"dataloader_{tag}.so"
     if not so.exists():
         cmd = [
             "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-            str(_SRC), "-o", str(so),
+            str(_SRC), str(_SRC_BPE), "-o", str(so),
         ]
         try:
             subprocess.run(
@@ -80,6 +81,14 @@ def _build() -> Optional[ctypes.CDLL]:
     lib.lumina_fnv1a64_batch.argtypes = [
         ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
         ctypes.c_long, ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.bpe_train.restype = ctypes.c_int32
+    lib.bpe_train.argtypes = [
+        ctypes.POINTER(ctypes.c_int32),  # word_data
+        ctypes.POINTER(ctypes.c_int64),  # word_offsets
+        ctypes.POINTER(ctypes.c_int64),  # word_counts
+        ctypes.c_int32, ctypes.c_int32,  # n_words, n_merges
+        ctypes.POINTER(ctypes.c_int32),  # merges_out
     ]
     return lib
 
@@ -253,3 +262,32 @@ def content_hashes(
             h = np.uint64((int(h) ^ b) * 1099511628211 & 0xFFFFFFFFFFFFFFFF)
         out[i] = h
     return out
+
+
+def bpe_train_native(
+    word_data: np.ndarray,
+    word_offsets: np.ndarray,
+    word_counts: np.ndarray,
+    n_merges: int,
+) -> Optional[np.ndarray]:
+    """Run the C++ BPE merge loop; None when the native lib is absent.
+
+    Returns [n_produced, 2] int32 merge pairs in merge order (merge i
+    creates token id 256+i). See bpe.cpp for the algorithm contract.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    word_data = np.ascontiguousarray(word_data, dtype=np.int32)
+    word_offsets = np.ascontiguousarray(word_offsets, dtype=np.int64)
+    word_counts = np.ascontiguousarray(word_counts, dtype=np.int64)
+    out = np.zeros((n_merges, 2), dtype=np.int32)
+    n = lib.bpe_train(
+        _as_c(word_data, ctypes.c_int32),
+        _as_c(word_offsets, ctypes.c_int64),
+        _as_c(word_counts, ctypes.c_int64),
+        len(word_counts),
+        n_merges,
+        _as_c(out, ctypes.c_int32),
+    )
+    return out[:n]

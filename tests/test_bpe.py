@@ -1,0 +1,102 @@
+"""Trainable byte-level BPE (data/bpe.py + native/bpe.cpp)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from luminaai_tpu.data.bpe import (
+    BPETokenizer,
+    _merge_loop_python,
+    pretokenize,
+    train_bpe,
+)
+
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    "the lazy dog sleeps while the quick fox runs",
+    "pack my box with five dozen liquor jugs",
+    "how vexingly quick daft zebras jump!",
+] * 8
+
+
+def test_roundtrip_exact():
+    tok = train_bpe(CORPUS, vocab_size=300)
+    for text in CORPUS + ["completely unseen text, with punctuation?!",
+                          "unicode: éè 中文 \U0001f600"]:
+        assert tok.decode(tok.encode(text)) == text
+
+
+def test_compresses_vs_bytes():
+    tok = train_bpe(CORPUS, vocab_size=400)
+    text = CORPUS[0]
+    assert len(tok.encode(text)) < 0.7 * len(text.encode())
+
+
+def test_merges_never_cross_pretokens():
+    tok = train_bpe(CORPUS, vocab_size=300)
+    # every learned token's bytes must sit inside one pretoken
+    for tid in range(256, tok.n_vocab):
+        piece = tok._bytes[tid].decode("utf-8", errors="replace")
+        assert len(pretokenize(piece)) <= 1 or piece.startswith(" "), piece
+
+
+def test_native_matches_python():
+    from luminaai_tpu.native import bpe_train_native, native_available
+
+    if not native_available():
+        pytest.skip("no native toolchain")
+    words = {}
+    for text in CORPUS:
+        for w in pretokenize(text):
+            words[w] = words.get(w, 0) + 1
+    seqs = [list(w.encode()) for w in words]
+    counts = list(words.values())
+    flat = np.asarray([t for w in seqs for t in w], dtype=np.int32)
+    offsets = np.zeros(len(seqs) + 1, dtype=np.int64)
+    np.cumsum([len(w) for w in seqs], out=offsets[1:])
+    native = bpe_train_native(
+        flat, offsets, np.asarray(counts, dtype=np.int64), 64
+    )
+    python = _merge_loop_python([list(w) for w in seqs], counts, 64)
+    assert [tuple(r) for r in native.tolist()] == python
+
+
+def test_save_load_and_backend(tmp_path):
+    tok = train_bpe(CORPUS, vocab_size=300)
+    path = str(tmp_path / "tok.json")
+    tok.save(path)
+    tok2 = BPETokenizer.load(path)
+    assert tok2.encode(CORPUS[0]) == tok.encode(CORPUS[0])
+
+    from luminaai_tpu.data.tokenizer import ConversationTokenizer
+
+    ct = ConversationTokenizer(model_name=f"bpe:{path}")
+    assert ct.backend.name == "bpe"
+    enc = ct.encode_conversation(
+        {"messages": [{"role": "user", "content": "the quick brown fox"}]}
+    )
+    assert len(enc["input_ids"]) > 0
+
+
+def test_train_stops_when_exhausted():
+    # tiny corpus cannot support 10k merges; trainer must stop, not loop
+    tok = train_bpe(["ab ab ab"], vocab_size=10_000)
+    assert tok.n_vocab < 300
+
+
+def test_cli_train_tokenizer(tmp_path, capsys):
+    from luminaai_tpu.cli import main as cli_main
+
+    data = tmp_path / "c.jsonl"
+    with open(data, "w") as f:
+        for text in CORPUS:
+            f.write(json.dumps({"messages": [
+                {"role": "user", "content": text}]}) + "\n")
+    out = str(tmp_path / "tok.json")
+    assert cli_main([
+        "data", "train-tokenizer", "--in", str(data), "--out", out,
+        "--vocab-size", "300",
+    ]) == 0
+    assert "trained 300-token BPE" in capsys.readouterr().out
+    assert BPETokenizer.load(out).n_vocab == 300
