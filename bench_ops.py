@@ -17,6 +17,8 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import sys
 import time
 from typing import Callable, Dict, List
 
@@ -160,35 +162,93 @@ def bench_loss(B=8, S=2048, H=1024, V=32768) -> List[Dict]:
     return rows
 
 
+def _run_suite(suite: str, small: bool) -> List[Dict]:
+    if suite == "attention":
+        return bench_attention(**(dict(B=1, S=256, Hq=4, Hkv=2, D=64)
+                                  if small else {}))
+    if suite == "moe":
+        return bench_moe_dispatch(**(dict(G=2, S=256, H=128, F=256)
+                                     if small else {}))
+    return bench_loss(**(dict(B=2, S=256, H=128, V=2048) if small else {}))
+
+
+def _child_main(suite: str, small: bool) -> None:
+    import jax
+
+    platform = jax.devices()[0].platform
+    rows = _run_suite(suite, small)
+    print(json.dumps({"platform": platform, "results": rows}))
+
+
 def main() -> None:
+    """Each suite runs in a subprocess with a timeout: a wedged TPU tunnel
+    can hang a remote compile indefinitely (observed: 35 min, futex-stuck),
+    and one stuck suite must not take down the others or the JSON output
+    (same robustness contract as bench.py)."""
+    import subprocess
+
     parser = argparse.ArgumentParser()
     parser.add_argument(
         "--suite", default="all", choices=["all", "attention", "moe", "loss"]
     )
     parser.add_argument("--small", action="store_true",
                         help="CPU-sized shapes for smoke testing")
+    parser.add_argument("--timeout", type=int, default=900,
+                        help="per-suite timeout (seconds)")
     args = parser.parse_args()
 
-    import jax
-
-    platform = jax.devices()[0].platform
+    suites = (
+        ["attention", "moe", "loss"] if args.suite == "all" else [args.suite]
+    )
     rows: List[Dict] = []
-    if args.suite in ("all", "attention"):
-        rows += bench_attention(**(dict(B=1, S=256, Hq=4, Hkv=2, D=64)
-                                   if args.small else {}))
-    if args.suite in ("all", "moe"):
-        rows += bench_moe_dispatch(**(dict(G=2, S=256, H=128, F=256)
-                                      if args.small else {}))
-    if args.suite in ("all", "loss"):
-        rows += bench_loss(**(dict(B=2, S=256, H=128, V=2048)
-                              if args.small else {}))
+    platform = None
+    errors: List[str] = []
+    for suite in suites:
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--child", suite] + (["--small"] if args.small else [])
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=args.timeout,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+        except subprocess.TimeoutExpired:
+            errors.append(f"{suite}: timeout after {args.timeout}s")
+            continue
+        parsed = None
+        for line in reversed(proc.stdout.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    candidate = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                # Validate the payload shape (stray JSON-ish log lines from
+                # the runtime must not be mistaken for the result; same
+                # guard as bench.py's metric check).
+                if isinstance(candidate, dict) and "results" in candidate:
+                    parsed = candidate
+                    break
+        if parsed is None:
+            errors.append(
+                f"{suite}: rc={proc.returncode} {proc.stderr[-300:]!r}"
+            )
+            continue
+        platform = parsed["platform"]
+        rows += parsed["results"]
 
-    width = max(len(r["op"]) for r in rows)
-    print(f"\n{'op':<{width}}  {'ms':>10}  shape   [{platform}]")
-    for r in rows:
-        print(f"{r['op']:<{width}}  {r['ms']:>10.3f}  {r['shape']}")
-    print(json.dumps({"platform": platform, "results": rows}))
+    if rows:
+        width = max(len(r["op"]) for r in rows)
+        print(f"\n{'op':<{width}}  {'ms':>10}  shape   [{platform}]")
+        for r in rows:
+            print(f"{r['op']:<{width}}  {r['ms']:>10.3f}  {r['shape']}")
+    out: Dict = {"platform": platform, "results": rows}
+    if errors:
+        out["errors"] = errors
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--child":
+        _child_main(sys.argv[2], "--small" in sys.argv)
+    else:
+        main()
