@@ -215,30 +215,19 @@ def _probe_backend(timeout: int = 90, tries: int = 2):
 
 def _run_child(name: str, timeout: int):
     """Run one ladder rung; returns (parsed_json | None, diagnostic_str)."""
+    from bench_common import run_child
+
     env = dict(os.environ)
     if name == "cpu_fallback":
         env["JAX_PLATFORMS"] = "cpu"
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--child", name],
-            capture_output=True,
-            text=True,
-            timeout=timeout,
-            env=env,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-        )
-    except subprocess.TimeoutExpired:
-        return None, f"{name}: timeout after {timeout}s"
-    for line in reversed(proc.stdout.strip().splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                parsed = json.loads(line)
-                if parsed.get("metric") == METRIC:
-                    return parsed, f"{name}: ok"
-            except json.JSONDecodeError:
-                pass
-    return None, f"{name}: rc={proc.returncode} stderr={proc.stderr[-500:]!r}"
+    return run_child(
+        [sys.executable, os.path.abspath(__file__), "--child", name],
+        timeout,
+        validate=lambda p: p.get("metric") == METRIC,
+        label=name,
+        env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
 
 
 def main() -> None:

@@ -185,8 +185,6 @@ def main() -> None:
     can hang a remote compile indefinitely (observed: 35 min, futex-stuck),
     and one stuck suite must not take down the others or the JSON output
     (same robustness contract as bench.py)."""
-    import subprocess
-
     parser = argparse.ArgumentParser()
     parser.add_argument(
         "--suite", default="all", choices=["all", "attention", "moe", "loss"]
@@ -203,35 +201,19 @@ def main() -> None:
     rows: List[Dict] = []
     platform = None
     errors: List[str] = []
+    from bench_common import run_child
+
     for suite in suites:
         cmd = [sys.executable, os.path.abspath(__file__),
                "--child", suite] + (["--small"] if args.small else [])
-        try:
-            proc = subprocess.run(
-                cmd, capture_output=True, text=True, timeout=args.timeout,
-                cwd=os.path.dirname(os.path.abspath(__file__)),
-            )
-        except subprocess.TimeoutExpired:
-            errors.append(f"{suite}: timeout after {args.timeout}s")
-            continue
-        parsed = None
-        for line in reversed(proc.stdout.strip().splitlines()):
-            line = line.strip()
-            if line.startswith("{"):
-                try:
-                    candidate = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-                # Validate the payload shape (stray JSON-ish log lines from
-                # the runtime must not be mistaken for the result; same
-                # guard as bench.py's metric check).
-                if isinstance(candidate, dict) and "results" in candidate:
-                    parsed = candidate
-                    break
+        parsed, diag = run_child(
+            cmd, args.timeout,
+            validate=lambda p: "results" in p,
+            label=suite,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
         if parsed is None:
-            errors.append(
-                f"{suite}: rc={proc.returncode} {proc.stderr[-300:]!r}"
-            )
+            errors.append(diag)
             continue
         platform = parsed["platform"]
         rows += parsed["results"]
@@ -245,6 +227,8 @@ def main() -> None:
     if errors:
         out["errors"] = errors
     print(json.dumps(out))
+    if not rows:
+        sys.exit(1)  # every suite failed: keep the CI failure signal
 
 
 if __name__ == "__main__":
