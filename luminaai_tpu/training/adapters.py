@@ -201,7 +201,7 @@ def make_lora_train_step(
     @jax.jit
     def step(carry, batch, rng):
         lora, opt_state = carry
-        (loss, metrics), grads = jax.value_and_grad(lora_loss, has_aux=True)(
+        (_, metrics), grads = jax.value_and_grad(lora_loss, has_aux=True)(
             lora, batch, rng
         )
         updates, opt_state = tx.update(grads, opt_state, lora)
@@ -309,7 +309,7 @@ def make_prompt_tuning_step(config: Config, model, base_params, tx):
     def step(carry, batch):
         prompt, opt_state = carry
         (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            carry[0], batch
+            prompt, batch
         )
         updates, opt_state = tx.update(grads, opt_state, prompt)
         prompt = optax.apply_updates(prompt, updates)
