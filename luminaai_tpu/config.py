@@ -127,8 +127,12 @@ class Config:
     # --- Parallelism (replaces ref DeepSpeed/FSDP/ColossalAI group) ---
     # Axis order = physical torus placement: trailing axes land on the
     # innermost ICI ring, so the chattiest collectives (tensor) go last.
-    mesh_axes: tuple = ("data", "fsdp", "expert", "sequence", "tensor")
+    mesh_axes: tuple = ("data", "pipe", "fsdp", "expert", "sequence", "tensor")
     data_parallel_size: int = -1  # -1 = infer remaining devices
+    # GPipe pipeline parallelism over the scanned layer stack
+    # (parallel/pipeline.py): stage p holds layers [p*L/P, (p+1)*L/P).
+    pipeline_parallel_size: int = 1
+    pipeline_microbatches: Optional[int] = None  # auto: = pipe size
     fsdp_parallel_size: int = 1
     expert_parallel_size: int = 1
     tensor_parallel_size: int = 1
@@ -275,9 +279,31 @@ class Config:
         assert self.adam_mu_dtype in (None, "bf16"), (
             f"invalid adam_mu_dtype {self.adam_mu_dtype}"
         )
-        for axis in ("fsdp", "expert", "tensor", "sequence"):
+        for axis in ("fsdp", "expert", "tensor", "sequence", "pipeline"):
             size = getattr(self, f"{axis}_parallel_size")
             assert size >= 1, f"{axis}_parallel_size must be >= 1"
+        if self.pipeline_parallel_size > 1:
+            assert self.scan_layers, (
+                "pipeline_parallel_size > 1 requires scan_layers=True "
+                "(stages slice the stacked layer axis)"
+            )
+            assert self.num_layers % self.pipeline_parallel_size == 0, (
+                "num_layers must divide evenly over pipeline stages"
+            )
+            n_micro = self.pipeline_microbatches or self.pipeline_parallel_size
+            assert self.batch_size % n_micro == 0, (
+                "batch_size must divide into pipeline_microbatches"
+            )
+            assert self.gradient_accumulation_steps == 1, (
+                "pipeline parallelism replaces grad accumulation: raise "
+                "pipeline_microbatches instead (same memory effect, no "
+                "extra pipeline bubbles)"
+            )
+            for axis in ("expert", "tensor", "sequence"):
+                assert getattr(self, f"{axis}_parallel_size") == 1, (
+                    f"pipeline parallelism composes with data/fsdp only "
+                    f"(for now); {axis}_parallel_size must be 1"
+                )
         if self.expert_parallel_size > 1 and self.use_moe:
             assert self.num_experts % self.expert_parallel_size == 0, (
                 "num_experts must divide evenly over expert_parallel_size"

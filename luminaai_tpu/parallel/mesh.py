@@ -28,7 +28,7 @@ logger = logging.getLogger(__name__)
 # Default axis order; overridden by Config.mesh_axes. Trailing axes get
 # devices that are closest on the physical torus (mesh_utils places the last
 # axis on the innermost ring), so the chattiest collectives (tensor) go last.
-MESH_AXES = ("data", "fsdp", "expert", "sequence", "tensor")
+MESH_AXES = ("data", "pipe", "fsdp", "expert", "sequence", "tensor")
 
 
 def mesh_shape_from_config(
@@ -37,11 +37,12 @@ def mesh_shape_from_config(
     """Resolve per-axis sizes; data axis (-1) absorbs remaining devices.
 
     Mirrors ref backend auto-sizing (world_size // model_parallel), but over
-    five named axes instead of DeepSpeed's dp/mp split.
+    six named axes instead of DeepSpeed's dp/mp split.
     """
     if n_devices is None:
         n_devices = jax.device_count()
     fixed = {
+        "pipe": config.pipeline_parallel_size,
         "fsdp": config.fsdp_parallel_size,
         "expert": config.expert_parallel_size,
         "sequence": config.sequence_parallel_size,
@@ -51,7 +52,7 @@ def mesh_shape_from_config(
     if n_devices % model_parallel != 0:
         raise ValueError(
             f"device count {n_devices} not divisible by model-parallel "
-            f"product {model_parallel} (fsdp×expert×sequence×tensor)"
+            f"product {model_parallel} (pipe×fsdp×expert×sequence×tensor)"
         )
     dp = config.data_parallel_size
     if dp == -1:

@@ -1,0 +1,292 @@
+"""GPipe pipeline parallelism over the scanned layer stack.
+
+The fifth model-parallel dimension (with fsdp/tensor/expert/sequence):
+stage p of P holds layers [p·L/P, (p+1)·L/P) — under `scan_layers=True`
+the stacked parameters carry a leading L axis, so a stage's weights are
+just that axis sharded over the 'pipe' mesh axis (sharding.py maps the
+'layers' logical axis to 'pipe'). The batch is split into microbatches
+that flow through stages with `lax.ppermute` hops under
+`jax.shard_map(axis_names={'pipe'})` — manual collectives over the pipe
+axis only, while data/fsdp sharding on every tensor stays automatic.
+
+Schedule (forward): tick t gives stage p microbatch (t - p); valid work
+happens for 0 <= t - p < n_micro (the classic (P-1)-tick bubble at each
+end). Bubble lanes compute on zeros and are masked out of outputs and
+metrics; their gradient contribution is exactly zero because nothing they
+produce reaches the loss. The backward pass is plain autodiff through the
+schedule (scan + ppermute transpose to the reverse schedule), so grads,
+clipping, and the optimizer reuse the standard train-step machinery.
+
+Embedding, final norm, and the fused LM-head CE run outside the
+pipelined region, replicated over 'pipe' (they are a few percent of step
+FLOPs; the layer stack is what pipelining is for).
+
+The reference has no pipeline engine of its own (DeepSpeed's sat unused
+behind its config); this is TPU-first coverage of the driver's
+tp/pp/dp/sp/ep contract.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from luminaai_tpu.config import Config
+from luminaai_tpu.models.transformer import TransformerBlock, scan_segments
+from luminaai_tpu.ops.fused import clip_by_global_norm, global_norm
+from luminaai_tpu.parallel.mesh import use_mesh
+from luminaai_tpu.parallel.sharding import (
+    TrainState,
+    batch_spec,
+    logical_axis_rules,
+)
+from luminaai_tpu.parallel.train_step import (
+    _ce,
+    _shifted_mask_weights,
+    shift_labels,
+)
+
+Batch = Dict[str, jax.Array]
+
+
+def pipeline_compatible(config: Config) -> Tuple[bool, str]:
+    """Whether the config's layer stack can be pipelined: one homogeneous
+    scan segment (uniform block kind) that divides evenly over stages."""
+    if config.pipeline_parallel_size <= 1:
+        return False, "pipeline_parallel_size is 1"
+    segs = scan_segments(config)
+    if len(segs) != 1 or len(segs[0][1]) != 1:
+        return False, (
+            f"layer stack is not one homogeneous segment (got {len(segs)} "
+            "segments); use moe_pattern 'all' or 'none'"
+        )
+    return True, ""
+
+
+def _stage_apply(
+    config: Config,
+    block: nn.Module,
+    stack_local: Any,
+    x: jax.Array,
+    rng: jax.Array,
+    n_local: int,
+    first_global_layer: jax.Array,
+):
+    """Run this stage's n_local layers over x via lax.scan.
+
+    stack_local: param tree with leading axis n_local (this stage's slice).
+    Returns (x, metrics_summed_over_local_layers).
+    """
+
+    def body(carry, xs):
+        layer_params, idx = xs
+        layer_rng = jax.random.fold_in(rng, idx)
+        out, _, metrics = block.apply(
+            {"params": layer_params},
+            carry,
+            rngs={"routing": layer_rng, "dropout": jax.random.fold_in(layer_rng, 1)},
+        )
+        return out, metrics
+
+    if config.gradient_checkpointing:
+        from luminaai_tpu.models.transformer import REMAT_POLICIES
+
+        body = jax.checkpoint(
+            body,
+            policy=REMAT_POLICIES.get(config.remat_policy),
+            prevent_cse=False,
+        )
+    idxs = first_global_layer + jnp.arange(n_local)
+    x, metrics = jax.lax.scan(body, x, (stack_local, idxs))
+    metrics = jax.tree.map(lambda m: m.sum(axis=0), metrics)
+    return x, metrics
+
+
+def make_pipeline_loss_fn(config: Config, model, mesh: Mesh) -> Callable:
+    """Loss over the GPipe schedule; drop-in signature for the train step.
+
+    model: the LuminaTransformer whose scanned params this runs against
+    (used for dtype/config; its param tree layout is what init produced).
+    """
+    ok, why = pipeline_compatible(config)
+    if not ok:
+        raise ValueError(f"config not pipeline-compatible: {why}")
+    assert config.fused_lm_head_ce, (
+        "pipeline train step requires fused_lm_head_ce (the LM head runs "
+        "outside the pipelined region on hidden states)"
+    )
+    Pn = config.pipeline_parallel_size
+    L = config.num_layers
+    n_local = L // Pn
+    n_micro = config.pipeline_microbatches or Pn
+    dtype = model.dtype
+    # Representative block: homogeneity was checked, so layer 0's kind
+    # (and param structure) matches every layer.
+    block = TransformerBlock(
+        config, layer_idx=0, dtype=dtype, deterministic=False
+    )
+
+    from luminaai_tpu.models.layers import Embedder, RMSNorm
+
+    embedder = Embedder(config, dtype=dtype, name=None)
+    final_norm = RMSNorm(config.rms_norm_eps, dtype=dtype)
+
+    def pipe_body(stack_local, x, rng):
+        """Manual over 'pipe' (shard_map): stack_local is this stage's
+        [n_local, ...] slice; x and rng are pipe-replicated."""
+        p = jax.lax.axis_index("pipe")
+        B = x.shape[0]
+        mb = B // n_micro
+        mbs = x.reshape(n_micro, mb, *x.shape[1:])
+        ticks = n_micro + Pn - 1
+        perm = [(i, (i + 1) % Pn) for i in range(Pn)]
+        first_layer = p * n_local
+
+        def one_tick(carry, t):
+            state, outs, macc = carry
+            recv = jax.lax.ppermute(state, "pipe", perm)
+            mb_idx = jnp.clip(t, 0, n_micro - 1)
+            feed = jax.lax.dynamic_index_in_dim(
+                mbs, mb_idx, axis=0, keepdims=False
+            )
+            x_in = jnp.where(p == 0, feed, recv)
+            my_mb = t - p  # microbatch this stage works on this tick
+            out, metrics = _stage_apply(
+                config, block, stack_local, x_in,
+                jax.random.fold_in(rng, my_mb), n_local,
+                first_layer,
+            )
+            valid = (my_mb >= 0) & (my_mb < n_micro)
+            # Collect finished microbatches on the last stage.
+            out_idx = jnp.clip(t - (Pn - 1), 0, n_micro - 1)
+            collect = valid & (p == Pn - 1)
+            outs = jnp.where(
+                collect,
+                jax.lax.dynamic_update_index_in_dim(outs, out, out_idx, 0),
+                outs,
+            )
+            macc = jax.tree.map(
+                lambda a, m: a + jnp.where(valid, m, 0.0), macc, metrics
+            )
+            return (out, outs, macc), None
+
+        varying = lambda a: jax.lax.pcast(a, ("pipe",), to="varying")
+        state0 = varying(jnp.zeros((mb, *x.shape[1:]), x.dtype))
+        outs0 = varying(jnp.zeros((n_micro, mb, *x.shape[1:]), x.dtype))
+        # Metric zeros with the right structure: one dry stage application
+        # under eval_shape costs nothing and avoids hand-listing keys.
+        m_shape = jax.eval_shape(
+            lambda: _stage_apply(
+                config, block, stack_local, state0, rng, n_local, first_layer
+            )[1]
+        )
+        macc0 = jax.tree.map(
+            lambda s: varying(jnp.zeros(s.shape, jnp.float32)), m_shape
+        )
+        (_, outs, macc), _ = jax.lax.scan(
+            one_tick, (state0, outs0, macc0), jnp.arange(ticks)
+        )
+        # Replicate results over the pipe axis: outputs live on the last
+        # stage, each stage's metric sums cover its own layers.
+        outs = jax.lax.psum(
+            jnp.where(p == Pn - 1, outs, jnp.zeros_like(outs)), "pipe"
+        )
+        macc = jax.lax.psum(macc, "pipe")
+        return outs.reshape(B, *x.shape[1:]), macc
+
+    def loss_fn(params, batch: Batch, rng: jax.Array):
+        ids = batch["input_ids"]
+        x = embedder.apply(
+            {"params": params["embedder"]}, ids, method="encode"
+        )
+        stack = params["scan_0"]["block_0"]
+        sharded = jax.shard_map(
+            pipe_body,
+            mesh=mesh,
+            axis_names=frozenset({"pipe"}),
+            in_specs=(P("pipe"), P(), P()),
+            out_specs=(P(), P()),
+        )
+        hidden, metrics_sum = sharded(stack, x, rng)
+        hidden = final_norm.apply({"params": params["final_norm"]}, hidden)
+
+        labels, valid = shift_labels(batch)
+        mask, weights = _shifted_mask_weights(batch, valid)
+        loss, metrics = _ce(
+            config, params, hidden, labels, mask, weights,
+            z_loss_weight=config.z_loss_weight,
+            label_smoothing=config.label_smoothing,
+        )
+        # Per-layer mean diagnostics + summed aux losses, matching the
+        # non-pipelined metric reduction (transformer._reduce_metrics).
+        aux_total = jnp.float32(0.0)
+        for key, v in metrics_sum.items():
+            if key.endswith("_loss"):
+                per_mb_sum = v / n_micro  # each microbatch crossed L layers
+                metrics[key] = per_mb_sum
+                aux_total = aux_total + per_mb_sum
+            else:
+                metrics[key] = v / (L * n_micro)
+        total = loss + aux_total
+        metrics["loss"] = total
+        metrics["aux_loss"] = aux_total
+        return total, metrics
+
+    return loss_fn
+
+
+def make_pipeline_train_step(
+    config: Config,
+    model,
+    state_shardings: TrainState,
+    mesh: Mesh,
+    schedule: Optional[optax.Schedule],
+    tx: optax.GradientTransformation,
+):
+    """Donated, sharded, jitted GPipe train step.
+
+    Same contract as parallel.train_step.make_train_step; requires
+    scan_layers + a homogeneous stack + pipeline_parallel_size > 1.
+    """
+    loss_fn = make_pipeline_loss_fn(config, model, mesh)
+    bspec = NamedSharding(mesh, batch_spec())
+
+    def train_step(state: TrainState, batch: Batch):
+        step_rng, new_rng = jax.random.split(state.rng)
+        (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, batch, step_rng
+        )
+        if config.grad_clip_norm > 0:
+            grads, grad_norm = clip_by_global_norm(
+                grads, config.grad_clip_norm
+            )
+        else:
+            grad_norm = global_norm(grads)
+        new_state = state.apply_gradients(grads, tx).replace(rng=new_rng)
+        metrics["grad_norm"] = grad_norm
+        if schedule is not None:
+            metrics["learning_rate"] = schedule(state.step)
+        return new_state, metrics
+
+    def traced(state, batch):
+        with use_mesh(mesh), nn.logical_axis_rules(logical_axis_rules(config)):
+            return train_step(state, batch)
+
+    jitted = jax.jit(
+        traced,
+        in_shardings=(state_shardings, bspec),
+        out_shardings=(state_shardings, None),
+        donate_argnums=(0,) if config.donate_state else (),
+    )
+
+    def call(state, batch):
+        with mesh:
+            return jitted(state, batch)
+
+    return call

@@ -38,7 +38,8 @@ from luminaai_tpu.config import Config
 LOGICAL_AXIS_RULES: Tuple[Tuple[str, Any], ...] = (
     # Leading scan axis on stacked per-layer params (scan_layers=True);
     # replicated — each device holds all layers of its shard.
-    ("layers", None),
+    # scanned stacks: the leading L axis becomes the pipeline axis
+    ("layers", "pipe"),
     ("embed", "fsdp"),
     ("vocab", "tensor"),
     ("heads", "tensor"),

@@ -207,7 +207,17 @@ def make_train_step(
     closed over (not stored in state), so a rebuilt step with a new
     transform reuses the same TrainState as long as the opt-state structure
     matches (e.g. LR overrides).
+
+    With pipeline_parallel_size > 1 this dispatches to the GPipe step
+    (parallel/pipeline.py) — same contract, layer stack pipelined over the
+    'pipe' mesh axis.
     """
+    if config.pipeline_parallel_size > 1:
+        from luminaai_tpu.parallel.pipeline import make_pipeline_train_step
+
+        return make_pipeline_train_step(
+            config, model, state_shardings, mesh, schedule, tx
+        )
     loss_fn = make_loss_fn(config, model)
     accum = config.gradient_accumulation_steps
     bspec = NamedSharding(mesh, batch_spec())

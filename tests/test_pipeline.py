@@ -1,0 +1,118 @@
+"""GPipe pipeline parallelism (parallel/pipeline.py) on the 8-dev CPU mesh."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from luminaai_tpu.config import Config
+from luminaai_tpu.models.transformer import LuminaTransformer
+from luminaai_tpu.parallel.mesh import build_mesh
+from luminaai_tpu.parallel.pipeline import (
+    make_pipeline_train_step,
+    pipeline_compatible,
+)
+from luminaai_tpu.parallel.sharding import init_sharded_state
+from luminaai_tpu.parallel.train_step import make_train_step
+from luminaai_tpu.training.optimizer import make_optimizer, make_schedule
+
+
+def pp_config(**kw) -> Config:
+    base = dict(
+        vocab_size=256,
+        hidden_size=64,
+        num_layers=4,
+        num_heads=4,
+        num_kv_heads=2,
+        seq_length=64,
+        intermediate_size=128,
+        batch_size=8,
+        use_flash_attention=False,
+        gradient_checkpointing=False,
+        precision="fp32",
+        routing_noise_std=0.0,
+        dropout=0.0,
+        scan_layers=True,
+        moe_pattern="none",
+        use_moe=False,
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+def run_steps(cfg, n_steps=1, seed=0):
+    model = LuminaTransformer(cfg)
+    schedule = make_schedule(cfg, 10)
+    tx = make_optimizer(cfg, 10, schedule)
+    mesh = build_mesh(cfg)
+    state, shardings = init_sharded_state(
+        cfg, model, tx, mesh, jax.random.key(seed)
+    )
+    if cfg.pipeline_parallel_size > 1:
+        step = make_pipeline_train_step(cfg, model, shardings, mesh, schedule, tx)
+    else:
+        step = make_train_step(cfg, model, shardings, mesh, schedule, tx)
+    ids = np.random.RandomState(0).randint(
+        1, cfg.vocab_size, (cfg.batch_size, cfg.seq_length)
+    )
+    batch = {"input_ids": jnp.asarray(ids, jnp.int32)}
+    losses = []
+    for _ in range(n_steps):
+        state, m = step(state, batch)
+        losses.append(float(m["ce_loss"]))
+    return losses, m
+
+
+class TestCompatibility:
+    def test_homogeneous_required(self):
+        cfg = pp_config(
+            use_moe=True, num_experts=4, moe_pattern="sandwich",
+            num_layers=8, pipeline_parallel_size=2,
+        )
+        ok, why = pipeline_compatible(cfg)
+        assert not ok and "segment" in why
+
+    def test_validation_requires_scan(self):
+        with pytest.raises(AssertionError, match="scan_layers"):
+            pp_config(scan_layers=False, pipeline_parallel_size=2)
+
+    def test_divisibility(self):
+        with pytest.raises(AssertionError, match="divide evenly"):
+            pp_config(num_layers=5, pipeline_parallel_size=2)
+
+
+class TestPipelineEquivalence:
+    def test_dense_pp2_matches_pp1(self):
+        """pp2 (with the dp remainder) must produce the same first-step CE
+        as the non-pipelined step from the same init and batch."""
+        losses1, _ = run_steps(pp_config())
+        losses2, _ = run_steps(pp_config(pipeline_parallel_size=2))
+        assert abs(losses1[0] - losses2[0]) < 5e-2, (losses1, losses2)
+
+    def test_moe_pp2_matches_pp1(self):
+        kw = dict(use_moe=True, num_experts=4, moe_pattern="all")
+        losses1, m1 = run_steps(pp_config(**kw))
+        losses2, m2 = run_steps(pp_config(pipeline_parallel_size=2, **kw))
+        assert abs(losses1[0] - losses2[0]) < 5e-2, (losses1, losses2)
+        # MoE aux metrics survive the pipelined reduction
+        assert "moe_aux_loss" in m2 and np.isfinite(float(m2["moe_aux_loss"]))
+
+    def test_pp2_training_reduces_loss(self):
+        losses, m = run_steps(
+            pp_config(pipeline_parallel_size=2, learning_rate=1e-3),
+            n_steps=8,
+        )
+        assert losses[-1] < losses[0], losses
+        assert np.isfinite(float(m["grad_norm"]))
+
+    def test_pp4_microbatches(self):
+        """4 stages, 8 microbatches: deeper pipeline + more splits."""
+        cfg = pp_config(
+            pipeline_parallel_size=4, pipeline_microbatches=8,
+            num_layers=4,
+        )
+        losses1, _ = run_steps(pp_config())
+        losses4, _ = run_steps(cfg)
+        assert abs(losses1[0] - losses4[0]) < 5e-2, (losses1, losses4)

@@ -140,7 +140,8 @@ def test_mesh_shape_inference():
     cfg = tiny_config(tensor_parallel_size=2)
     shape = mesh_shape_from_config(cfg, 8)
     assert shape == {
-        "data": 4, "fsdp": 1, "expert": 1, "sequence": 1, "tensor": 2
+        "data": 4, "pipe": 1, "fsdp": 1, "expert": 1, "sequence": 1,
+        "tensor": 2,
     }
     with pytest.raises(ValueError):
         mesh_shape_from_config(tiny_config(tensor_parallel_size=3), 8)
@@ -179,7 +180,8 @@ def test_all_five_axes_together():
         tx = make_optimizer(cfg, 4, schedule)
         mesh = build_mesh(cfg)
         assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
-            "data": 2, "fsdp": 2, "expert": 2, "sequence": 2, "tensor": 2,
+            "data": 2, "pipe": 1, "fsdp": 2, "expert": 2, "sequence": 2,
+            "tensor": 2,
         }
         state, shardings = init_sharded_state(
             cfg, model, tx, mesh, jax.random.key(0)
