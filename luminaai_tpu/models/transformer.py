@@ -331,8 +331,8 @@ class LuminaTransformer(nn.Module):
     ):
         """`nn.scan` over homogeneous layer segments (see scan_segments).
 
-        Params gain a leading 'layers' axis per segment (replicated across
-        the mesh via the ('layers', None) rule). KV caches are structured
+        Params gain a leading 'layers' axis per segment (sharded over the
+        'pipe' mesh axis under pipeline parallelism, replicated otherwise). KV caches are structured
         per segment: a tuple over unit positions of (k, v) stacked over the
         scan axis — init_cache builds the matching structure.
         """

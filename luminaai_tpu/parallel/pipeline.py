@@ -28,24 +28,17 @@ tp/pp/dp/sp/ep contract.
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import optax
 from flax import linen as nn
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from luminaai_tpu.config import Config
 from luminaai_tpu.models.transformer import TransformerBlock, scan_segments
-from luminaai_tpu.ops.fused import clip_by_global_norm, global_norm
-from luminaai_tpu.parallel.mesh import use_mesh
-from luminaai_tpu.parallel.sharding import (
-    TrainState,
-    batch_spec,
-    logical_axis_rules,
-)
+from luminaai_tpu.parallel.sharding import TrainState
 from luminaai_tpu.parallel.train_step import (
     _ce,
     _shifted_mask_weights,
@@ -251,42 +244,15 @@ def make_pipeline_train_step(
 ):
     """Donated, sharded, jitted GPipe train step.
 
-    Same contract as parallel.train_step.make_train_step; requires
-    scan_layers + a homogeneous stack + pipeline_parallel_size > 1.
+    Same contract as parallel.train_step.make_train_step — in fact it IS
+    that step builder with the GPipe loss injected (grad accumulation is
+    validated to 1 under pp, so the shared body's accumulation path
+    degenerates to a single value_and_grad; clipping, donation, and metric
+    reporting stay single-sourced).
     """
-    loss_fn = make_pipeline_loss_fn(config, model, mesh)
-    bspec = NamedSharding(mesh, batch_spec())
+    from luminaai_tpu.parallel.train_step import make_train_step
 
-    def train_step(state: TrainState, batch: Batch):
-        step_rng, new_rng = jax.random.split(state.rng)
-        (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, batch, step_rng
-        )
-        if config.grad_clip_norm > 0:
-            grads, grad_norm = clip_by_global_norm(
-                grads, config.grad_clip_norm
-            )
-        else:
-            grad_norm = global_norm(grads)
-        new_state = state.apply_gradients(grads, tx).replace(rng=new_rng)
-        metrics["grad_norm"] = grad_norm
-        if schedule is not None:
-            metrics["learning_rate"] = schedule(state.step)
-        return new_state, metrics
-
-    def traced(state, batch):
-        with use_mesh(mesh), nn.logical_axis_rules(logical_axis_rules(config)):
-            return train_step(state, batch)
-
-    jitted = jax.jit(
-        traced,
-        in_shardings=(state_shardings, bspec),
-        out_shardings=(state_shardings, None),
-        donate_argnums=(0,) if config.donate_state else (),
+    return make_train_step(
+        config, model, state_shardings, mesh, schedule, tx,
+        loss_fn=make_pipeline_loss_fn(config, model, mesh),
     )
-
-    def call(state, batch):
-        with mesh:
-            return jitted(state, batch)
-
-    return call

@@ -36,9 +36,9 @@ from luminaai_tpu.config import Config
 # (logical axis, mesh axis/axes). First matching rule wins; a logical axis
 # mapped to None stays replicated along that dimension.
 LOGICAL_AXIS_RULES: Tuple[Tuple[str, Any], ...] = (
-    # Leading scan axis on stacked per-layer params (scan_layers=True);
-    # replicated — each device holds all layers of its shard.
-    # scanned stacks: the leading L axis becomes the pipeline axis
+    # Leading scan axis on stacked per-layer params (scan_layers=True):
+    # the pipeline axis — stage p holds its layer slice (replicated when
+    # pipe=1).
     ("layers", "pipe"),
     ("embed", "fsdp"),
     ("vocab", "tensor"),

@@ -199,6 +199,7 @@ def make_train_step(
     mesh: Mesh,
     schedule: Optional[optax.Schedule],
     tx: optax.GradientTransformation,
+    loss_fn: Optional[Callable] = None,
 ):
     """Build the donated, sharded, jitted train step.
 
@@ -212,13 +213,13 @@ def make_train_step(
     (parallel/pipeline.py) — same contract, layer stack pipelined over the
     'pipe' mesh axis.
     """
-    if config.pipeline_parallel_size > 1:
+    if config.pipeline_parallel_size > 1 and loss_fn is None:
         from luminaai_tpu.parallel.pipeline import make_pipeline_train_step
 
         return make_pipeline_train_step(
             config, model, state_shardings, mesh, schedule, tx
         )
-    loss_fn = make_loss_fn(config, model)
+    loss_fn = loss_fn or make_loss_fn(config, model)
     accum = config.gradient_accumulation_steps
     bspec = NamedSharding(mesh, batch_spec())
 

@@ -279,6 +279,29 @@ class Trainer:
         change. Returns False when the batch can't split further.
         """
         cfg = self.config
+        if cfg.pipeline_parallel_size > 1:
+            # Under pp the memory knob is the pipeline microbatch count,
+            # not grad accum (which the GPipe step doesn't read and
+            # validate() rejects).
+            old = cfg.pipeline_microbatches or cfg.pipeline_parallel_size
+            new_micro = old * factor
+            if new_micro > cfg.batch_size or cfg.batch_size % new_micro != 0:
+                logger.warning(
+                    "cannot raise pipeline microbatches to %d (batch %d)",
+                    new_micro, cfg.batch_size,
+                )
+                return False
+            cfg.pipeline_microbatches = new_micro
+            self._rebuild_steps()
+            logger.warning(
+                "pipeline microbatch split: %d -> %d (%s)", old, new_micro,
+                reason,
+            )
+            self._interventions.append(
+                {"step": self.global_step, "kind": "microbatch_split",
+                 "from": old, "to": new_micro, "reason": reason}
+            )
+            return True
         new_accum = cfg.gradient_accumulation_steps * factor
         if new_accum > cfg.batch_size or cfg.batch_size % new_accum != 0:
             logger.warning(
@@ -322,10 +345,21 @@ class Trainer:
             )
             return False
         old_bs, old_accum = cfg.batch_size, cfg.gradient_accumulation_steps
-        micro = max(1, old_bs // old_accum)
-        new_accum = max(1, new_batch_size // micro)
-        while new_batch_size % new_accum != 0 and new_accum > 1:
-            new_accum -= 1
+        if cfg.pipeline_parallel_size > 1:
+            # Keep the pipeline microbatch size (the memory knob under pp)
+            # constant, mirroring the accum rescale below.
+            old_micro = cfg.pipeline_microbatches or cfg.pipeline_parallel_size
+            mb_rows = max(1, old_bs // old_micro)
+            new_micro = max(1, new_batch_size // mb_rows)
+            while new_batch_size % new_micro != 0 and new_micro > 1:
+                new_micro -= 1
+            cfg.pipeline_microbatches = new_micro
+            new_accum = old_accum
+        else:
+            micro = max(1, old_bs // old_accum)
+            new_accum = max(1, new_batch_size // micro)
+            while new_batch_size % new_accum != 0 and new_accum > 1:
+                new_accum -= 1
         cfg.batch_size = new_batch_size
         cfg.gradient_accumulation_steps = new_accum
         self._rebuild_steps()

@@ -107,6 +107,22 @@ class TestPipelineEquivalence:
         assert losses[-1] < losses[0], losses
         assert np.isfinite(float(m["grad_norm"]))
 
+    def test_pp2_tp2_matches(self):
+        """Tensor parallelism inside pipeline stages: XLA auto-shards the
+        projections under the partial-manual shard_map."""
+        losses1, _ = run_steps(pp_config())
+        losses2, _ = run_steps(
+            pp_config(pipeline_parallel_size=2, tensor_parallel_size=2)
+        )
+        assert abs(losses1[0] - losses2[0]) < 5e-2, (losses1, losses2)
+
+    def test_pp_rejects_expert_parallel(self):
+        with pytest.raises(AssertionError, match="data/fsdp/tensor"):
+            pp_config(
+                pipeline_parallel_size=2, expert_parallel_size=2,
+                use_moe=True, num_experts=4, moe_pattern="all",
+            )
+
     def test_pp4_microbatches(self):
         """4 stages, 8 microbatches: deeper pipeline + more splits."""
         cfg = pp_config(
