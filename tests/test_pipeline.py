@@ -132,3 +132,66 @@ class TestPipelineEquivalence:
         losses1, _ = run_steps(pp_config())
         losses4, _ = run_steps(cfg)
         assert abs(losses1[0] - losses4[0]) < 5e-2, (losses1, losses4)
+
+
+def test_trainer_lifecycle_under_pp(tmp_path):
+    """Full Trainer loop with pipeline parallelism: train steps, the
+    (non-pipelined) eval step, checkpoint save, and bit-exact resume must
+    all work with pipe-sharded stacked params."""
+    from luminaai_tpu.training.trainer import Trainer
+
+    cfg = pp_config(
+        pipeline_parallel_size=2, learning_rate=1e-3, max_steps=4,
+    )
+    cfg.output_dir = str(tmp_path / "run")
+    cfg.save_every_n_batches = 10**9
+    cfg.eval_every_n_batches = 10**9
+    cfg.health_check_interval = 100
+
+    def data():
+        rng = np.random.RandomState(0)
+        while True:
+            yield {
+                "input_ids": rng.randint(
+                    1, cfg.vocab_size, (cfg.batch_size, cfg.seq_length)
+                ).astype(np.int32)
+            }
+
+    def eval_data():
+        rng = np.random.RandomState(1)
+        for _ in range(2):
+            yield {
+                "input_ids": rng.randint(
+                    1, cfg.vocab_size, (cfg.batch_size, cfg.seq_length)
+                ).astype(np.int32)
+            }
+
+    trainer = Trainer(cfg, train_data=data, eval_data=eval_data)
+    summary = trainer.train()
+    assert summary["final_step"] == 4
+    ev = trainer.evaluate(max_batches=2)
+    assert np.isfinite(ev.get("eval_loss", float("nan")))
+    trainer.save_checkpoint(force=True)
+    step_before = trainer.global_step
+    params_before = jax.tree.map(np.asarray, trainer.state.params)
+    opt_before = jax.tree.map(np.asarray, trainer.state.opt_state)
+    trainer.close()
+
+    cfg2 = pp_config(
+        pipeline_parallel_size=2, learning_rate=1e-3, max_steps=6,
+    )
+    cfg2.output_dir = cfg.output_dir
+    cfg2.auto_resume = True
+    trainer2 = Trainer(cfg2, train_data=data)
+    assert trainer2.global_step == step_before
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), b),
+        trainer2.state.params, params_before,
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), b),
+        trainer2.state.opt_state, opt_before,
+    )
+    summary2 = trainer2.train()
+    assert summary2["final_step"] == 6
+    trainer2.close()
