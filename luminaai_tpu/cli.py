@@ -45,6 +45,12 @@ def _apply_overrides(cfg, args) -> None:
         ("experiment", "experiment_name"),
         ("grad_accum", "gradient_accumulation_steps"),
         ("tokenizer", "tokenizer_name"),
+        ("dp", "data_parallel_size"),
+        ("pp", "pipeline_parallel_size"),
+        ("fsdp", "fsdp_parallel_size"),
+        ("tp", "tensor_parallel_size"),
+        ("ep", "expert_parallel_size"),
+        ("sp", "sequence_parallel_size"),
     ]:
         val = getattr(args, flag, None)
         if val is not None:
@@ -53,6 +59,9 @@ def _apply_overrides(cfg, args) -> None:
         cfg.use_moe = False
     if getattr(args, "no_flash", False):
         cfg.use_flash_attention = False
+    # Axis-implied settings (ring attention under sp, scan_layers and the
+    # grad-accum fold under pp) — one shared code path on Config.
+    cfg.normalize_parallelism()
 
 
 def build_config(args):
@@ -860,6 +869,14 @@ def build_parser() -> argparse.ArgumentParser:
             "--auto-hardware", action="store_true",
             help="optimize parallelism for detected devices",
         )
+        par = sp.add_argument_group("parallelism (docs/parallelism.md)")
+        par.add_argument("--dp", type=int, help="data axis (-1 = auto)")
+        par.add_argument("--pp", type=int, help="pipeline stages (GPipe)")
+        par.add_argument("--fsdp", type=int, help="ZeRO-3-style shard ways")
+        par.add_argument("--tp", type=int, help="tensor-parallel ways")
+        par.add_argument("--ep", type=int, help="expert-parallel ways")
+        par.add_argument("--sp", type=int,
+                         help="sequence/ring-attention ways")
 
     t = sub.add_parser("train", help="train a model")
     add_config_flags(t)

@@ -240,6 +240,39 @@ class Config:
             self.mesh_axes = tuple(self.mesh_axes)
         self.validate()
 
+    def normalize_parallelism(self) -> None:
+        """Resolve axis-implied settings so a bare axis-size request is a
+        complete, valid config (the CLI calls this; programmatic users can
+        too — see docs/parallelism.md):
+
+          - sequence parallelism rides ring attention;
+          - pipeline parallelism slices the scanned layer stack, and grad
+            accumulation folds into pipeline microbatches (same memory
+            effect, no extra bubbles), capped to a divisor of the batch.
+            micro_batch_size is cleared so __post_init__ cannot re-derive
+            the accumulation this fold just removed.
+        """
+        if self.sequence_parallel_size > 1 and not self.use_ring_attention:
+            self.use_ring_attention = True
+        if self.pipeline_parallel_size > 1:
+            if not self.scan_layers:
+                self.scan_layers = True
+            if self.gradient_accumulation_steps > 1:
+                n_micro = (
+                    self.pipeline_microbatches or self.pipeline_parallel_size
+                )
+                cand = min(
+                    n_micro * self.gradient_accumulation_steps,
+                    self.batch_size,
+                )
+                while cand > n_micro and self.batch_size % cand != 0:
+                    cand -= 1
+                if self.batch_size % cand != 0:
+                    cand = n_micro  # validate() reports if this fails too
+                self.pipeline_microbatches = cand
+                self.gradient_accumulation_steps = 1
+                self.micro_batch_size = self.batch_size
+
     # -- validation ------------------------------------------------------
     def validate(self) -> None:
         assert self.hidden_size % self.num_heads == 0, (
