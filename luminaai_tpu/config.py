@@ -238,12 +238,14 @@ class Config:
             )
         if isinstance(self.mesh_axes, list):
             self.mesh_axes = tuple(self.mesh_axes)
+        self.normalize_parallelism()
         self.validate()
 
     def normalize_parallelism(self) -> None:
         """Resolve axis-implied settings so a bare axis-size request is a
-        complete, valid config (the CLI calls this; programmatic users can
-        too — see docs/parallelism.md):
+        complete, valid config. Runs in __post_init__ before validate(), so
+        constructor/preset/file-loaded configs all get it (docs/
+        parallelism.md):
 
           - sequence parallelism rides ring attention;
           - pipeline parallelism slices the scanned layer stack, and grad
@@ -265,10 +267,10 @@ class Config:
                     n_micro * self.gradient_accumulation_steps,
                     self.batch_size,
                 )
+                # Loop exits with cand dividing batch_size, or cand ==
+                # n_micro (whose divisibility validate() then checks).
                 while cand > n_micro and self.batch_size % cand != 0:
                     cand -= 1
-                if self.batch_size % cand != 0:
-                    cand = n_micro  # validate() reports if this fails too
                 self.pipeline_microbatches = cand
                 self.gradient_accumulation_steps = 1
                 self.micro_batch_size = self.batch_size

@@ -101,11 +101,14 @@ def _stage_apply(
     return x, metrics
 
 
-def make_pipeline_loss_fn(config: Config, model, mesh: Mesh) -> Callable:
+def make_pipeline_loss_fn(
+    config: Config, model, mesh: Mesh, deterministic: bool = False
+) -> Callable:
     """Loss over the GPipe schedule; drop-in signature for the train step.
 
     model: the LuminaTransformer whose scanned params this runs against
     (used for dtype/config; its param tree layout is what init produced).
+    deterministic=True gives the eval path (no routing noise/dropout).
     """
     ok, why = pipeline_compatible(config)
     if not ok:
@@ -122,7 +125,7 @@ def make_pipeline_loss_fn(config: Config, model, mesh: Mesh) -> Callable:
     # Representative block: homogeneity was checked, so layer 0's kind
     # (and param structure) matches every layer.
     block = TransformerBlock(
-        config, layer_idx=0, dtype=dtype, deterministic=False
+        config, layer_idx=0, dtype=dtype, deterministic=deterministic
     )
 
     from luminaai_tpu.models.layers import Embedder, RMSNorm
@@ -255,4 +258,28 @@ def make_pipeline_train_step(
     return make_train_step(
         config, model, state_shardings, mesh, schedule, tx,
         loss_fn=make_pipeline_loss_fn(config, model, mesh),
+    )
+
+
+def make_pipeline_eval_step(
+    config: Config,
+    model,
+    state_shardings: TrainState,
+    mesh: Mesh,
+):
+    """Forward-only eval over the GPipe schedule (deterministic routing) —
+    the non-pipelined eval step would all-gather every stage's layers onto
+    every device per scan iteration. Reuses make_eval_step's wrapper with
+    the GPipe loss injected (mirror of the train-step delegation)."""
+    from luminaai_tpu.parallel.train_step import make_eval_step
+
+    pipe_loss = make_pipeline_loss_fn(config, model, mesh, deterministic=True)
+    fixed_rng = jax.random.key(0)  # deterministic path ignores it
+
+    def eval_loss(params, batch):
+        _, metrics = pipe_loss(params, batch, fixed_rng)
+        return metrics
+
+    return make_eval_step(
+        config, model, state_shardings, mesh, loss_fn=eval_loss
     )

@@ -257,9 +257,18 @@ def make_train_step(
 
 
 def make_eval_step(
-    config: Config, model, state_shardings: TrainState, mesh: Mesh
+    config: Config, model, state_shardings: TrainState, mesh: Mesh,
+    loss_fn: Optional[Callable] = None,
 ):
-    """Forward-only eval step: loss + metrics, deterministic routing."""
+    """Forward-only eval step: loss + metrics, deterministic routing.
+
+    Dispatches to the pipelined eval (the GPipe loss injected through the
+    same wrapper) under pipeline_parallel_size > 1; `loss_fn(params,
+    batch) -> metrics` overrides the standard eval loss when given."""
+    if config.pipeline_parallel_size > 1 and loss_fn is None:
+        from luminaai_tpu.parallel.pipeline import make_pipeline_eval_step
+
+        return make_pipeline_eval_step(config, model, state_shardings, mesh)
 
     def eval_loss(params, batch: Batch):
         model_out, aux = model.apply(
@@ -276,11 +285,12 @@ def make_eval_step(
         metrics["loss"] = loss + aux.get("aux_loss", 0.0)
         return metrics
 
+    run_loss = loss_fn or eval_loss
     bspec = NamedSharding(mesh, batch_spec())
 
     def traced(state, batch):
         with use_mesh(mesh), nn.logical_axis_rules(logical_axis_rules(config)):
-            return eval_loss(state.params, batch)
+            return run_loss(state.params, batch)
 
     jitted = jax.jit(traced, in_shardings=(state_shardings, bspec))
 

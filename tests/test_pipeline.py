@@ -74,9 +74,18 @@ class TestCompatibility:
         ok, why = pipeline_compatible(cfg)
         assert not ok and "segment" in why
 
-    def test_validation_requires_scan(self):
-        with pytest.raises(AssertionError, match="scan_layers"):
-            pp_config(scan_layers=False, pipeline_parallel_size=2)
+    def test_constructor_normalizes_scan(self):
+        # normalize_parallelism runs in __post_init__: a bare pp request
+        # auto-enables scan_layers instead of erroring.
+        cfg = pp_config(scan_layers=False, pipeline_parallel_size=2)
+        assert cfg.scan_layers
+
+    def test_constructor_folds_accum(self):
+        cfg = pp_config(
+            pipeline_parallel_size=2, gradient_accumulation_steps=2,
+        )
+        assert cfg.gradient_accumulation_steps == 1
+        assert cfg.pipeline_microbatches == 4  # 2 stages x folded accum 2
 
     def test_divisibility(self):
         with pytest.raises(AssertionError, match="divide evenly"):
@@ -195,3 +204,31 @@ def test_trainer_lifecycle_under_pp(tmp_path):
     summary2 = trainer2.train()
     assert summary2["final_step"] == 6
     trainer2.close()
+
+
+def test_pipelined_eval_matches_nonpipelined():
+    """The pp eval step must give the same CE as a pp1 eval on the same
+    weights (deterministic path, no noise)."""
+    from luminaai_tpu.parallel.train_step import make_eval_step
+
+    ids = np.random.RandomState(0).randint(1, 256, (8, 64))
+
+    def eval_for(pp):
+        cfg = pp_config(
+            pipeline_parallel_size=pp,
+            **({"pipeline_microbatches": 4} if pp > 1 else {}),
+        )
+        model = LuminaTransformer(cfg)
+        schedule = make_schedule(cfg, 10)
+        tx = make_optimizer(cfg, 10, schedule)
+        mesh = build_mesh(cfg)
+        state, sh = init_sharded_state(
+            cfg, model, tx, mesh, jax.random.key(0)
+        )
+        step = make_eval_step(cfg, model, sh, mesh)
+        m = step(state, {"input_ids": jnp.asarray(ids, jnp.int32)})
+        return float(m["ce_loss"])
+
+    l1 = eval_for(1)
+    l2 = eval_for(2)
+    assert abs(l1 - l2) < 5e-2, (l1, l2)
