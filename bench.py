@@ -117,6 +117,9 @@ def _child_config(name: str, n_chips: int = 1):
 
 def _child_main(name: str) -> None:
     """Runs in a subprocess; prints the JSON result line on success."""
+    child_t0 = time.perf_counter()
+    budget = float(os.environ.get("BENCH_CHILD_BUDGET_S", "0") or 0)
+
     import jax
 
     if name == "cpu_fallback":
@@ -170,8 +173,43 @@ def _child_main(name: str) -> None:
     t0 = time.perf_counter()
     for _ in range(steps):
         state, metrics = step(state, batch)
-    float(metrics["loss"])
+    loss_val = float(metrics["loss"])
     dt = time.perf_counter() - t0
+    drop_val = float(metrics.get("moe_drop_rate", 0.0))
+
+    # Steady-state MoE routing: the 20-step window above starts from random
+    # init, so its drop rate is an initialization artifact (r2 measured 22.7%
+    # there). Keep stepping (cycling fresh batches so the router sees varied
+    # token mixes) and report the drop rate after the router has settled.
+    drop_steady = None
+    if cfg.use_moe and name != "cpu_fallback":
+        rng = np.random.RandomState(1)
+        extra_batches = [
+            {
+                "input_ids": jnp.asarray(
+                    rng.randint(
+                        1, cfg.vocab_size, size=(cfg.batch_size, cfg.seq_length)
+                    ),
+                    jnp.int32,
+                )
+            }
+            for _ in range(4)
+        ]
+        steady_steps = 150 if platform == "tpu" else 10
+        tail = []
+        for i in range(steady_steps):
+            # This loop is a nice-to-have diagnostic: never let it eat the
+            # rung's timeout and cost the headline number. Sync every 10
+            # steps and bail at 75% of the child budget.
+            if budget and i % 10 == 0:
+                float(metrics["loss"])  # sync: async dispatch hides elapsed
+                if time.perf_counter() - child_t0 > 0.75 * budget:
+                    break
+            state, metrics = step(state, extra_batches[i % 4])
+            if i >= steady_steps - 10:
+                tail.append(float(metrics.get("moe_drop_rate", 0.0)))
+        if tail:
+            drop_steady = round(sum(tail) / len(tail), 4)
 
     tokens = steps * cfg.batch_size * cfg.seq_length
     tps_chip = tokens / dt / n_chips
@@ -184,10 +222,18 @@ def _child_main(name: str) -> None:
     mfu = round(sample["mfu"], 4) if platform == "tpu" else None
 
     result = {
-        "metric": METRIC,
+        "metric": (
+            "train_tokens_per_sec_per_chip_dense200"
+            if name == "dense200"
+            else METRIC
+        ),
         "value": round(tps_chip, 1),
         "unit": "tokens/sec/chip",
-        "vs_baseline": round(tps_chip / REF_MOE_TOKENS_PER_SEC, 3),
+        "vs_baseline": round(
+            tps_chip
+            / (119_000.0 if name == "dense200" else REF_MOE_TOKENS_PER_SEC),
+            3,
+        ),
         "extras": {
             "chips": n_chips,
             "platform": platform,
@@ -198,8 +244,9 @@ def _child_main(name: str) -> None:
             "seq": cfg.seq_length,
             "mfu": mfu,
             "model_tflops_per_sec": round(sample["tflops_per_sec"], 2),
-            "loss": round(float(metrics["loss"]), 4),
-            "moe_drop_rate": round(float(metrics.get("moe_drop_rate", 0.0)), 4),
+            "loss": round(loss_val, 4),
+            "moe_drop_rate": round(drop_val, 4),
+            "moe_drop_rate_steady": drop_steady,
             "step_ms": round(dt / steps * 1e3, 2),
             "compile_s": round(compile_s, 1),
         },
@@ -236,12 +283,15 @@ def _run_child(name: str, timeout: int):
     from bench_common import run_child
 
     env = dict(os.environ)
+    env["BENCH_CHILD_BUDGET_S"] = str(timeout)
     if name == "cpu_fallback":
         env["JAX_PLATFORMS"] = "cpu"
     return run_child(
         [sys.executable, os.path.abspath(__file__), "--child", name],
         timeout,
-        validate=lambda p: p.get("metric") == METRIC,
+        validate=lambda p: str(p.get("metric", "")).startswith(
+            "train_tokens_per_sec_per_chip"
+        ),
         label=name,
         env=env,
         cwd=os.path.dirname(os.path.abspath(__file__)),
@@ -271,7 +321,23 @@ def main() -> None:
                 # instead of letting the child's note claim it was absent.
                 extras["note"] = "flagship_failed_on_tpu_cpu_fallback"
                 extras["ladder_diag"] = "; ".join(diagnostics)[-800:]
-            print(json.dumps(result))
+            print(json.dumps(result), flush=True)
+            if platform == "tpu" and name.startswith("flagship"):
+                # Dense comparison rung (ref BENCHMARKS.md publishes dense
+                # headlines too: 200M ~119k tok/s). Runs AFTER the main
+                # line is printed so a sidecar hang can never cost the
+                # headline artifact; result lands in DENSE_BENCH.json.
+                dense, ddiag = _run_child("dense200", 700)
+                if dense is not None:
+                    dense["baseline_note"] = "ref dense 200M ~119k tok/s"
+                    with open(
+                        os.path.join(
+                            os.path.dirname(os.path.abspath(__file__)),
+                            "DENSE_BENCH.json",
+                        ),
+                        "w",
+                    ) as f:
+                        json.dump(dense, f, indent=2)
             return
     print(
         json.dumps(

@@ -1,0 +1,18 @@
+#!/bin/bash
+# Probe the TPU tunnel every 5 minutes; when it answers, run the perf sweep
+# and leave results in scripts/sweep_out.txt. Single-shot: exits after sweep.
+cd /root/repo
+PROBE='import jax, jax.numpy as jnp
+x = jnp.ones((1024, 1024), jnp.bfloat16)
+float((x @ x).sum())
+print("PROBE_OK", jax.devices()[0].platform)'
+while true; do
+  if timeout 90 python -c "$PROBE" 2>/dev/null | grep -q "PROBE_OK tpu"; then
+    echo "$(date -u +%FT%TZ) tunnel up, starting sweep" >> scripts/sweep_out.txt
+    timeout 3600 python scripts/perf_sweep.py base saveouts_gather gatherd saveouts chunk1024 b24_saveouts_gather mu16 scan >> scripts/sweep_out.txt 2>&1
+    echo "$(date -u +%FT%TZ) sweep done rc=$?" >> scripts/sweep_out.txt
+    exit 0
+  fi
+  echo "$(date -u +%FT%TZ) tunnel down" >> scripts/watcher_log.txt
+  sleep 300
+done

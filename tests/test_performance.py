@@ -115,3 +115,52 @@ def test_eval_step_not_slower_than_train(step_setup):
     assert eval_per_step < train_per_step * 2.0, (
         eval_per_step, train_per_step,
     )
+
+
+def test_sort_and_gather_dispatch_not_slower_than_einsum():
+    """Perf tripwire (VERDICT r2 weak #6): the sort and gather MoE dispatch
+    engines exist because the einsum one materializes a [tokens, E, cap]
+    one-hot; if either regresses to slower-than-einsum even on a small CPU
+    model, something structural broke. Margin is loose (2x) — this guards
+    order-of-magnitude regressions, not micro-speed."""
+    import dataclasses
+
+    base = Config(
+        vocab_size=512,
+        hidden_size=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        seq_length=256,
+        batch_size=8,
+        use_moe=True,
+        num_experts=8,
+        moe_top_k=2,
+        use_flash_attention=False,
+        precision="fp32",
+    )
+    times = {}
+    for engine in ("einsum", "sort", "gather"):
+        cfg = dataclasses.replace(base, moe_dispatch=engine)
+        model = LuminaTransformer(cfg)
+        schedule = make_schedule(cfg, 100)
+        tx = make_optimizer(cfg, 100, schedule)
+        mesh = build_mesh(cfg)
+        state, shardings = init_sharded_state(
+            cfg, model, tx, mesh, jax.random.key(0)
+        )
+        step = make_train_step(cfg, model, shardings, mesh, schedule, tx)
+        ids = np.random.RandomState(0).randint(
+            1, cfg.vocab_size, (cfg.batch_size, cfg.seq_length)
+        )
+        batch = {"input_ids": jnp.asarray(ids, jnp.int32)}
+        state, m = step(state, batch)  # compile
+        float(m["loss"])
+        n = 8
+        t0 = time.perf_counter()
+        for _ in range(n):
+            state, m = step(state, batch)
+        float(m["loss"])
+        times[engine] = (time.perf_counter() - t0) / n
+    assert times["sort"] < times["einsum"] * 2.0, times
+    assert times["gather"] < times["einsum"] * 2.0, times
