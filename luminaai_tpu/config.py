@@ -62,6 +62,10 @@ class Config:
     router_z_loss_weight: float = 1e-3
     routing_temperature: float = 1.0
     routing_noise_std: float = 0.1
+    # Whole-expert dropout during training: each step a Bernoulli mask
+    # removes experts from routing, forcing load to spread (anti-collapse;
+    # ref trainer.py:1495 enable_expert_dropout). 0 disables.
+    expert_dropout_rate: float = 0.0
     moe_pattern: str = "all"
     dense_start_layers: int = 2
     dense_end_layers: int = 2
@@ -197,6 +201,9 @@ class Config:
     # Runtime capacity-factor / routing-temperature tuning (each change
     # recompiles the step; ref trainer.py:1450,1471).
     enable_moe_routing_optimization: bool = True
+    # Orchestrator may raise AdamW weight decay on a slow sustained loss
+    # rise (ref trainer.py:1792 adjust_weight_decay's adaptive role).
+    enable_adaptive_wd: bool = True
     # Gradient-noise-driven effective-batch growth (recompiles + reshapes
     # the data contract; opt-in; ref trainer.py:1626).
     enable_batch_size_optimization: bool = False
@@ -295,6 +302,9 @@ class Config:
             assert self.capacity_factor > 0
             assert self.moe_dispatch in ("sort", "gather", "einsum"), (
                 f"invalid moe_dispatch {self.moe_dispatch}"
+            )
+            assert 0.0 <= self.expert_dropout_rate <= 0.5, (
+                "expert_dropout_rate must be in [0, 0.5]"
             )
         if self.use_mod:
             assert 0.0 < self.mod_capacity_factor <= 1.0, (

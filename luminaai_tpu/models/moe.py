@@ -196,6 +196,19 @@ class MoELayer(nn.Module):
                 * cfg.routing_noise_std
             )
             gate_logits = gate_logits + noise
+        if not deterministic and cfg.expert_dropout_rate > 0:
+            # Whole-expert dropout (ref trainer.py:1495 enable_expert_dropout):
+            # mask a Bernoulli subset of experts out of routing for this step
+            # so the router can't collapse onto a favorite. Softmax over the
+            # masked logits renormalizes mass onto survivors. Keep-all
+            # fallback guards the (rate^E) chance of an empty mask.
+            keep = jax.random.bernoulli(
+                self.make_rng("routing"),
+                1.0 - cfg.expert_dropout_rate,
+                (E,),
+            )
+            keep = jnp.where(keep.any(), keep, jnp.ones_like(keep))
+            gate_logits = jnp.where(keep[None, None, :], gate_logits, -1e9)
         router_probs = jax.nn.softmax(gate_logits, axis=-1)
 
         if cfg.moe_dispatch in ("sort", "gather"):

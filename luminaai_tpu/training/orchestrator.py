@@ -36,7 +36,9 @@ logger = logging.getLogger(__name__)
 class AdaptiveDecision:
     """One proposed intervention (ref orchestrator.py:70)."""
 
-    kind: str  # lr_adjust | rollback | add_expert | prune_expert | clip_tighten
+    kind: str  # lr_adjust | rollback | add_expert | prune_expert |
+    # clip_tighten | capacity_* | temperature_* | batch_size |
+    # expert_dropout | weight_decay
     params: Dict[str, Any]
     reason: str
     confidence: float  # 0..1
@@ -328,6 +330,45 @@ class RealTimeAnalytics:
         flat = np.where(np.abs(dl) < 1e-4)[0]
         return int(future[flat[0]]) if flat.size else None
 
+    # -- trajectory (ref orchestrator.py:253 predict_training_trajectory) --
+    def predict_training_trajectory(self) -> Optional[Dict[str, Any]]:
+        """Classify where training is heading from the recent loss slope.
+
+        Ref buckets by raw slope with a gap that mislabels slow convergence
+        as divergence; here the sign decides the class and |slope| <= eps is
+        the plateau band."""
+        if len(self.buffer) < 10:
+            return None
+        losses = np.array(
+            [m["loss"] for m in list(self.buffer)[-10:]], dtype=np.float64
+        )
+        if not np.all(np.isfinite(losses)):
+            return None
+        slope = float(np.polyfit(np.arange(losses.size), losses, 1)[0])
+        if abs(slope) <= 1e-4:
+            return {
+                "prediction": "plateau",
+                "confidence": 0.8,
+                "suggested_action": "increase_lr_or_change_architecture",
+                "expected_improvement": 0.1,
+                "loss_slope": slope,
+            }
+        if slope < 0:
+            return {
+                "prediction": "healthy_convergence",
+                "confidence": 0.9,
+                "suggested_action": "continue",
+                "expected_improvement": abs(slope) * 100,
+                "loss_slope": slope,
+            }
+        return {
+            "prediction": "potential_divergence",
+            "confidence": 0.7,
+            "suggested_action": "reduce_lr_or_add_regularization",
+            "expected_improvement": 0.05,
+            "loss_slope": slope,
+        }
+
     # -- anomalies (ref :555 detect_training_anomalies) -------------------
     def detect_anomalies(self) -> List[Dict[str, Any]]:
         t = self.thresholds
@@ -529,6 +570,8 @@ class AdaptiveTrainingOrchestrator:
         # at/before this step instead.
         self._best_loss = float("inf")
         self._last_healthy_step = 0
+        self._collapse_free_checks = 0
+        self._edropout_enabled_by_me = False
         self._base_lr = self.config.learning_rate
         self.analytics.thresholds["gradient_explosion_threshold"] = (
             self.config.grad_norm_threshold
@@ -557,6 +600,7 @@ class AdaptiveTrainingOrchestrator:
             self.config, summary.get("final_metrics", {})
         )
         summary["adaptive_decisions"] = [d.to_dict() for d in self.decisions]
+        summary["trajectory"] = self.analytics.predict_training_trajectory()
         return summary
 
     # -- per-interval hook -------------------------------------------------
@@ -600,6 +644,10 @@ class AdaptiveTrainingOrchestrator:
     # -- decision fusion (ref :929 _process_real_time_metrics) -------------
     def _decide(self, step: int) -> Optional[AdaptiveDecision]:
         anomalies = self.analytics.detect_anomalies()
+        if any(a["type"] == "expert_collapse" for a in anomalies):
+            self._collapse_free_checks = 0
+        else:
+            self._collapse_free_checks += 1
         for a in anomalies:
             if a["severity"] == "critical" and self.config.emergency_override_enabled:
                 kind = (
@@ -610,10 +658,44 @@ class AdaptiveTrainingOrchestrator:
                     confidence=0.9, step=step,
                 )
             if a["type"] == "expert_collapse":
+                self._collapse_free_checks = 0
+                # Gate on the TRAINER's config: that is the object the
+                # intervention mutates (self.config may be a caller-supplied
+                # copy), and a mismatch here would re-fire + recompile every
+                # health check.
+                if (
+                    self.trainer.config.use_moe
+                    and self.trainer.config.expert_dropout_rate == 0.0
+                ):
+                    # First response: force routing to spread (ref
+                    # trainer.py:1495); clip tightening is the follow-up if
+                    # collapse persists with dropout already on.
+                    return AdaptiveDecision(
+                        kind="expert_dropout", params={"rate": 0.1},
+                        reason=a["description"], confidence=0.6, step=step,
+                    )
                 return AdaptiveDecision(
                     kind="clip_tighten", params={"anomaly": a},
                     reason=a["description"], confidence=0.5, step=step,
                 )
+
+        if (
+            self._edropout_enabled_by_me
+            and self.trainer.config.expert_dropout_rate > 0.0
+            and self._collapse_free_checks >= 5
+        ):
+            # Dropout served its purpose; leaving the Bernoulli mask on for
+            # the rest of the run would keep perturbing healthy routing.
+            # Only reverts a rate THIS orchestrator enabled — a user-config
+            # rate is policy, not an intervention.
+            return AdaptiveDecision(
+                kind="expert_dropout", params={"rate": 0.0},
+                reason=(
+                    f"expert collapse cleared for {self._collapse_free_checks}"
+                    " consecutive health checks"
+                ),
+                confidence=0.7, step=step,
+            )
 
         warmup_steps = int(
             self.trainer.total_steps * self.config.warmup_ratio
@@ -671,6 +753,32 @@ class AdaptiveTrainingOrchestrator:
                     params={"new_value": prop["new_value"]},
                     reason=prop["reasoning"],
                     confidence=prop.get("confidence", 0.5),
+                    step=step,
+                )
+
+        if self.config.enable_adaptive_wd and in_body:
+            # Slow sustained loss rise that never trips the spike/divergence
+            # rules above: add regularization (ref trainer.py:1792's stated
+            # use: adapting weight decay to training phase / overfitting).
+            traj = self.analytics.predict_training_trajectory()
+            if (
+                traj is not None
+                and traj["prediction"] == "potential_divergence"
+                and self.config.weight_decay < 0.1
+            ):
+                return AdaptiveDecision(
+                    kind="weight_decay",
+                    params={
+                        "new_value": round(
+                            min(0.1, max(self.config.weight_decay, 0.005) * 2),
+                            4,
+                        )
+                    },
+                    reason=(
+                        f"loss creeping up (slope {traj['loss_slope']:.2e}): "
+                        f"{traj['suggested_action']}"
+                    ),
+                    confidence=0.5,
                     step=step,
                 )
         return None
@@ -741,6 +849,21 @@ class AdaptiveTrainingOrchestrator:
                 applied = t.adjust_batch_size(
                     decision.params["new_value"], reason=decision.reason
                 )
+            elif kind == "expert_dropout":
+                t.enable_expert_dropout(
+                    decision.params["rate"], reason=decision.reason
+                )
+                applied = (
+                    t.config.expert_dropout_rate == decision.params["rate"]
+                )
+                if applied:
+                    self._edropout_enabled_by_me = decision.params["rate"] > 0
+                    self._collapse_free_checks = 0
+            elif kind == "weight_decay":
+                t.adjust_weight_decay(
+                    decision.params["new_value"], reason=decision.reason
+                )
+                applied = True
             decision.applied = applied
             if applied:
                 # An infeasible no-op must not burn the cooldown window.

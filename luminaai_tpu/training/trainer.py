@@ -412,6 +412,53 @@ class Trainer:
              "from": old, "to": new_temp, "reason": reason}
         )
 
+    def enable_expert_dropout(self, rate: float, reason: str = "") -> None:
+        """Enable whole-expert dropout mid-run to break expert collapse
+        (ref trainer.py:1495 enable_expert_dropout). rate=0 disables."""
+        cfg = self.config
+        if not cfg.use_moe:
+            logger.warning("cannot enable expert dropout: MoE not enabled")
+            return
+        rate = float(rate)
+        if not 0.0 <= rate <= 0.5:
+            # Check before mutating: an assert inside validate() would land
+            # after the config already holds the bad rate.
+            raise ValueError(f"expert_dropout_rate {rate} not in [0, 0.5]")
+        old = cfg.expert_dropout_rate
+        cfg.expert_dropout_rate = rate
+        # Eval routing is deterministic — the dropout mask never traces into
+        # the eval step, so only the train step needs a rebuild.
+        self.train_step = make_train_step(
+            cfg, self.model, self.shardings, self.mesh,
+            self._active_schedule, self.tx,
+        )
+        logger.warning("expert dropout %.2f -> %.2f (%s)", old, rate, reason)
+        self._interventions.append(
+            {"step": self.global_step, "kind": "expert_dropout",
+             "from": old, "to": rate, "reason": reason}
+        )
+
+    def adjust_weight_decay(self, new_wd: float, reason: str = "") -> None:
+        """Change AdamW weight decay mid-run (ref trainer.py:1792
+        adjust_weight_decay). The optimizer is rebuilt against the mutated
+        config; adamw state (mu/nu/count) is decay-independent, so the live
+        optimizer state carries over untouched."""
+        old = self.config.weight_decay
+        self.config.weight_decay = float(new_wd)
+        self.tx = make_optimizer(
+            self.config, self.total_steps, self._active_schedule
+        )
+        # Weight decay lives in the optimizer only; eval_step never sees it.
+        self.train_step = make_train_step(
+            self.config, self.model, self.shardings, self.mesh,
+            self._active_schedule, self.tx,
+        )
+        logger.warning("weight decay %.3g -> %.3g (%s)", old, new_wd, reason)
+        self._interventions.append(
+            {"step": self.global_step, "kind": "weight_decay",
+             "from": old, "to": new_wd, "reason": reason}
+        )
+
     def _rebuild_steps(self) -> None:
         """Recompile train/eval steps against the (mutated) config. Param
         and optimizer trees are untouched — only traced constants and

@@ -93,6 +93,33 @@ class TestMoELayer:
         out2, _ = layer_train.apply(variables, x, rngs={"routing": jax.random.PRNGKey(2)})
         assert not jnp.allclose(out1, out2)
 
+    def test_expert_dropout_starves_dropped_experts(self):
+        """With expert_dropout_rate > 0 the step's Bernoulli mask must take
+        whole experts out of routing: their utilization goes to ~0 while
+        survivors pick up the load (ref trainer.py:1495)."""
+        cfg = moe_config(expert_dropout_rate=0.5, routing_noise_std=0.0)
+        rng = jax.random.PRNGKey(0)
+        x = jax.random.normal(rng, (2, 64, cfg.hidden_size))
+        layer_train = MoELayer(cfg, dtype=jnp.float32, deterministic=False)
+        variables = layer_train.init({"params": rng, "routing": rng}, x)
+        # Find an rng whose mask actually drops >=1 expert (rate 0.5, E=4:
+        # overwhelmingly likely per draw; scan a few keys to be deterministic).
+        for seed in range(8):
+            _, metrics = layer_train.apply(
+                variables, x, rngs={"routing": jax.random.PRNGKey(seed)}
+            )
+            util = np.asarray(metrics["expert_utilization"])
+            if (util < 1e-3).any():
+                assert util.max() > 1.0  # survivors absorb the load
+                break
+        else:
+            raise AssertionError("no expert ever dropped across 8 rngs")
+        # Deterministic (eval) path ignores the dropout config entirely.
+        layer_eval = MoELayer(cfg, dtype=jnp.float32, deterministic=True)
+        out_a, _ = layer_eval.apply(variables, x)
+        out_b, _ = layer_eval.apply(variables, x)
+        assert jnp.allclose(out_a, out_b)
+
     def test_grad_flows_to_router(self):
         cfg = moe_config()
         rng = jax.random.PRNGKey(0)

@@ -214,6 +214,95 @@ def test_orchestrated_run_end_to_end(tmp_path):
     t.close()
 
 
+def test_trajectory_prediction_classes():
+    """predict_training_trajectory buckets by loss slope (ref
+    orchestrator.py:253)."""
+    a = RealTimeAnalytics()
+    assert a.predict_training_trajectory() is None  # cold start
+    for i in range(20):
+        a.observe(i, 3.0 - 0.05 * i, 1.0)
+    t = a.predict_training_trajectory()
+    assert t["prediction"] == "healthy_convergence"
+    a = RealTimeAnalytics()
+    for i in range(20):
+        a.observe(i, 1.5, 1.0)
+    assert a.predict_training_trajectory()["prediction"] == "plateau"
+    a = RealTimeAnalytics()
+    for i in range(20):
+        a.observe(i, 1.0 + 0.01 * i, 1.0)
+    t = a.predict_training_trajectory()
+    assert t["prediction"] == "potential_divergence"
+    assert t["suggested_action"] == "reduce_lr_or_add_regularization"
+
+
+def test_orchestrator_fires_expert_dropout_on_collapse(tmp_path):
+    """Synthetic expert collapse → expert_dropout intervention (ref
+    trainer.py:1495); the rebuilt step must run with the dropout mask."""
+    cfg = tiny_config(
+        tmp_path, use_moe=True, num_experts=4, max_steps=400,
+        min_override_threshold=0.2, enable_adaptive_lr=False,
+    )
+    t = Trainer(cfg, train_data=patterned_data(cfg),
+                checkpoint_dir=str(tmp_path / "ckpt"))
+    orch = AdaptiveTrainingOrchestrator(t)
+    collapsed = np.array([3.2, 0.01, 0.4, 0.39])
+    for i in range(5, 305, 5):
+        orch.on_metrics(
+            i, {"loss": 1.0, "grad_norm": 1.0,
+                "expert_utilization": collapsed, "moe_drop_rate": 0.0},
+        )
+    fired = [d for d in orch.decisions if d.kind == "expert_dropout" and d.applied]
+    assert fired, [d.to_dict() for d in orch.decisions]
+    assert cfg.expert_dropout_rate == 0.1
+    batch = t._put(next(patterned_data(cfg)()))
+    t.state, m = t.train_step(t.state, batch)
+    assert np.isfinite(float(m["loss"]))
+    # Collapse persisting WITH dropout on falls back to clip tightening.
+    for i in range(305, 505, 5):
+        orch.on_metrics(
+            i, {"loss": 1.0, "grad_norm": 1.0,
+                "expert_utilization": collapsed, "moe_drop_rate": 0.0},
+        )
+    assert any(d.kind == "clip_tighten" and d.applied for d in orch.decisions)
+    # Once routing recovers and stays healthy, the orchestrator reverts the
+    # dropout it enabled (it must not perturb healthy routing forever).
+    healthy = np.array([1.1, 0.9, 1.0, 1.0])
+    for i in range(505, 905, 5):
+        orch.on_metrics(
+            i, {"loss": 1.0, "grad_norm": 1.0,
+                "expert_utilization": healthy, "moe_drop_rate": 0.0},
+        )
+    assert cfg.expert_dropout_rate == 0.0, [
+        d.to_dict() for d in orch.decisions
+    ]
+    t.close()
+
+
+def test_orchestrator_raises_weight_decay_on_loss_creep(tmp_path):
+    """Slow sustained loss rise (no spike) → weight_decay intervention (ref
+    trainer.py:1792); optimizer state must survive the tx rebuild."""
+    cfg = tiny_config(
+        tmp_path, max_steps=1000, min_override_threshold=0.2,
+        enable_adaptive_lr=False, enable_batch_size_optimization=False,
+    )
+    t = Trainer(cfg, train_data=patterned_data(cfg),
+                checkpoint_dir=str(tmp_path / "ckpt"))
+    batch = t._put(next(patterned_data(cfg)()))
+    t.state, _ = t.train_step(t.state, batch)  # materialize opt state
+    wd0 = cfg.weight_decay
+    orch = AdaptiveTrainingOrchestrator(t)
+    for i in range(5, 505, 5):
+        # +0.002/observation: too slow for the spike/divergence rules, but a
+        # clearly positive slope for the trajectory classifier.
+        orch.on_metrics(i, {"loss": 1.0 + 0.002 * (i // 5), "grad_norm": 1.0})
+    fired = [d for d in orch.decisions if d.kind == "weight_decay" and d.applied]
+    assert fired, [d.to_dict() for d in orch.decisions]
+    assert cfg.weight_decay > wd0
+    t.state, m = t.train_step(t.state, batch)  # rebuilt step + carried state
+    assert np.isfinite(float(m["loss"]))
+    t.close()
+
+
 # -- scaler ----------------------------------------------------------------
 def test_chinchilla_plan():
     cfg = Config(hidden_size=64, num_layers=2, num_heads=4, num_kv_heads=2,
