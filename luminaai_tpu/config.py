@@ -77,6 +77,16 @@ class Config:
     # 'einsum' = GShard one-hot dispatch (O(S·E·C) memory, MXU-only data
     # movement — useful for A/B in bench_ops).
     moe_dispatch: str = "sort"
+    # Internal: explicit expert-axis activation constraints in MoELayer.
+    # The pipeline builders flip this off inside the manual-pipe region
+    # (XLA partitioner group-check crash); everywhere else leave True.
+    moe_ep_constraints: bool = True
+    # Internal: manual expert parallelism — tokens sharded over the
+    # 'expert' mesh axis, explicit tiled all-to-alls around the expert
+    # FFN. Set by the 1F1B pipeline builders (auto-SPMD ep cannot
+    # partition inside the manual-pipe region); requires being inside a
+    # shard_map with a manual 'expert' axis.
+    moe_manual_ep: bool = False
 
     # --- MoD (mixture of depths) ---
     use_mod: bool = False
@@ -137,6 +147,11 @@ class Config:
     # (parallel/pipeline.py): stage p holds layers [p*L/P, (p+1)*L/P).
     pipeline_parallel_size: int = 1
     pipeline_microbatches: Optional[int] = None  # auto: = pipe size
+    # '1f1b': fused fwd+bwd schedule, per-stage live activations bounded by
+    # ~2P regardless of microbatch count (the PipeDream-flush memory
+    # profile); 'gpipe': all-forward-then-autodiff (simpler, more live
+    # activations — A/B and eval path).
+    pipeline_schedule: str = "1f1b"
     fsdp_parallel_size: int = 1
     expert_parallel_size: int = 1
     tensor_parallel_size: int = 1
@@ -328,6 +343,9 @@ class Config:
             size = getattr(self, f"{axis}_parallel_size")
             assert size >= 1, f"{axis}_parallel_size must be >= 1"
         if self.pipeline_parallel_size > 1:
+            assert self.pipeline_schedule in ("1f1b", "gpipe"), (
+                f"invalid pipeline_schedule {self.pipeline_schedule}"
+            )
             assert self.scan_layers, (
                 "pipeline_parallel_size > 1 requires scan_layers=True "
                 "(stages slice the stacked layer axis)"
@@ -344,15 +362,33 @@ class Config:
                 "pipeline_microbatches instead (same memory effect, no "
                 "extra pipeline bubbles)"
             )
-            # pp composes with data/fsdp/tensor (tp inside a stage is
-            # auto-sharded by XLA under the partial-manual shard_map and
-            # verified loss-equal in tests). expert/sequence need
-            # collectives that XLA's SPMD partitioner currently rejects
-            # inside the manual-pipe region (observed partitioner crash).
-            for axis in ("expert", "sequence"):
-                assert getattr(self, f"{axis}_parallel_size") == 1, (
-                    f"pipeline parallelism composes with data/fsdp/tensor "
-                    f"only; {axis}_parallel_size must be 1"
+            # pp composes with data/fsdp/tensor/expert (tp inside a stage
+            # is auto-sharded by XLA under the partial-manual shard_map;
+            # ep rides the expert-sharded weights — activation-reshard
+            # constraints are dropped in-region, see models/moe.py
+            # moe_ep_constraints). Ring-attention sequence parallelism
+            # would nest a second manual region inside the pipe schedule;
+            # XLA's SPMD partitioner rejects the collectives it needs
+            # (observed partitioner group-check crash).
+            assert self.sequence_parallel_size == 1, (
+                "pipeline parallelism composes with data/fsdp/tensor/"
+                "expert only; sequence_parallel_size must be 1"
+            )
+            if self.expert_parallel_size > 1:
+                assert self.pipeline_schedule == "1f1b", (
+                    "pp x ep requires pipeline_schedule='1f1b' (manual "
+                    "expert parallelism lives in the 1F1B region)"
+                )
+                assert not self.use_mod, (
+                    "pp x ep with MoD is unsupported (MoD aux metrics are "
+                    "not expert-shard aware)"
+                )
+                assert (
+                    self.batch_size // n_micro
+                ) % self.expert_parallel_size == 0, (
+                    "microbatch size must divide over expert_parallel_size "
+                    "under pipeline parallelism (tokens shard over the "
+                    "expert axis inside the pipe region)"
                 )
         if self.expert_parallel_size > 1 and self.use_moe:
             assert self.num_experts % self.expert_parallel_size == 0, (

@@ -170,12 +170,21 @@ class MoELayer(nn.Module):
             (H, E),
             jnp.float32,
         )
+        # Under manual expert parallelism (inside the 1F1B pipe region) the
+        # passed-in wi/wo hold only this shard's E/ep experts; declare the
+        # local shape so flax's apply-time shape check accepts the slice.
+        # Init always happens on the non-manual model (full E).
+        E_w = (
+            E // cfg.expert_parallel_size
+            if cfg.moe_manual_ep and cfg.expert_parallel_size > 1
+            else E
+        )
         wi = self.param(
             "wi",
             nn.with_logical_partitioning(
                 default_init(cfg.init_std), ("expert", "embed", "mlp_fused")
             ),
-            (E, H, 2 * F),
+            (E_w, H, 2 * F),
             jnp.float32,
         )
         wo = self.param(
@@ -183,7 +192,7 @@ class MoELayer(nn.Module):
             nn.with_logical_partitioning(
                 default_init(cfg.init_std / jnp.sqrt(2.0)), ("expert", "mlp", "embed")
             ),
-            (E, F, H),
+            (E_w, F, H),
             jnp.float32,
         )
 
@@ -270,16 +279,40 @@ class MoELayer(nn.Module):
                 "gsec->e", dispatch.astype(jnp.float32)
             )
 
-        expert_in = nn.with_logical_constraint(
-            expert_in, ("expert", "activation_exp_batch", None, None)
-        )
+        # Manual expert parallelism (inside the 1F1B manual-pipe region):
+        # tokens arrive SHARDED over the 'expert' mesh axis (ep borrows the
+        # data dimension, the DeepSpeed-MoE layout), this shard's wi/wo
+        # hold only E/ep experts, and a tiled all-to-all exchanges token
+        # buffers so each shard runs its experts over every shard's tokens.
+        manual_ep = cfg.moe_manual_ep and cfg.expert_parallel_size > 1
+        if manual_ep:
+            # [E, G, C, H] -> [E/ep, ep*G, C, H]: split experts to their
+            # owners, gather all shards' token groups.
+            expert_in = jax.lax.all_to_all(
+                expert_in, "expert", split_axis=0, concat_axis=1, tiled=True
+            )
+        elif cfg.moe_ep_constraints:
+            # Force the all-to-all dispatch layout: activations sharded
+            # over 'expert' so each shard runs only its experts' matmuls.
+            # Skipped inside the 1F1B manual-pipe region, where the
+            # explicit reshard trips XLA's SPMD partitioner group check.
+            expert_in = nn.with_logical_constraint(
+                expert_in, ("expert", "activation_exp_batch", None, None)
+            )
         fused = jnp.einsum("egch,ehf->egcf", expert_in, wi.astype(self.dtype))
         gate_act, up = jnp.split(fused, 2, axis=-1)
         act = nn.silu(gate_act) * up
         expert_out = jnp.einsum("egcf,efh->egch", act, wo.astype(self.dtype))
-        expert_out = nn.with_logical_constraint(
-            expert_out, ("expert", "activation_exp_batch", None, None)
-        )
+        if manual_ep:
+            # [E/ep, ep*G, C, H] -> [E, G, C, H]: every token group gets
+            # all experts' outputs back for the local combine.
+            expert_out = jax.lax.all_to_all(
+                expert_out, "expert", split_axis=1, concat_axis=0, tiled=True
+            )
+        elif cfg.moe_ep_constraints:
+            expert_out = nn.with_logical_constraint(
+                expert_out, ("expert", "activation_exp_batch", None, None)
+            )
 
         if cfg.moe_dispatch in ("sort", "gather"):
             out_flat = expert_out.transpose(1, 0, 2, 3).reshape(
@@ -303,17 +336,26 @@ class MoELayer(nn.Module):
         # f_e: fraction of tokens whose slot went to expert e; P_e: mean prob.
         f = tokens_per_expert / (G * S * k + 1e-9)
         p = router_probs.mean(axis=(0, 1))
+        lse2 = jnp.mean(jax.nn.logsumexp(gate_logits, axis=-1) ** 2)
+        drop = dropped.mean()
+        if manual_ep:
+            # Token shards each saw 1/ep of the batch: average the routing
+            # stats over the expert axis so the aux/z losses are computed
+            # from GLOBAL fractions (sum-of-products ≠ product-of-sums —
+            # matching the non-manual math exactly, grads included via the
+            # differentiable pmean).
+            f = jax.lax.pmean(f, "expert")
+            p = jax.lax.pmean(p, "expert")
+            lse2 = jax.lax.pmean(lse2, "expert")
+            drop = jax.lax.pmean(drop, "expert")
         aux_loss = jnp.clip(
             jnp.sum(f * p) * E * cfg.load_balancing_weight, max=1.0
         )
-        z_loss = (
-            jnp.mean(jax.nn.logsumexp(gate_logits, axis=-1) ** 2)
-            * cfg.router_z_loss_weight
-        )
+        z_loss = lse2 * cfg.router_z_loss_weight
         metrics = {
             "moe_aux_loss": aux_loss,
             "moe_z_loss": z_loss,
-            "moe_drop_rate": dropped.mean(),
+            "moe_drop_rate": drop,
             "expert_utilization": f * E,  # 1.0 == perfectly balanced
         }
         return out.astype(self.dtype), metrics

@@ -90,17 +90,53 @@ def fused_lm_head_cross_entropy(
     fp32); labels/mask/weights as in cross_entropy_loss (caller-shifted).
     Returns identical (loss, metrics) to the unfused path.
     """
+    weights = jnp.ones(hidden.shape[:2], dtype=jnp.float32)
+    if loss_mask is not None:
+        weights = weights * loss_mask.astype(jnp.float32)
+    if loss_weights is not None:
+        weights = weights * loss_weights.astype(jnp.float32)
+
+    nll_sum, w_sum, z_sum, n_tok = fused_lm_head_ce_sums(
+        hidden, embedding, labels, weights,
+        label_smoothing=label_smoothing, chunk_size=chunk_size,
+    )
+
+    denom = jnp.maximum(w_sum, 1.0)
+    loss = nll_sum / denom
+    metrics = {
+        "ce_loss": loss,
+        "perplexity": jnp.exp(jnp.clip(loss, max=20.0)),
+        "tokens_in_loss": n_tok,
+    }
+    if z_loss_weight > 0.0:
+        z = z_sum / denom * z_loss_weight
+        loss = loss + z
+        metrics["z_loss"] = z
+    metrics["total_loss"] = loss
+    return loss, metrics
+
+
+def fused_lm_head_ce_sums(
+    hidden: jax.Array,
+    embedding: jax.Array,
+    labels: jax.Array,
+    weights: jax.Array,
+    label_smoothing: float = 0.0,
+    chunk_size: int = 256,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Sum-form fused CE: (nll_sum, w_sum, z_sum, n_tok), un-normalized.
+
+    For callers that combine partial losses exactly — the 1F1B pipeline
+    computes CE per microbatch and needs token-sums it can divide by the
+    GLOBAL weight total (a per-microbatch mean would weight microbatches
+    with unequal valid-token counts wrongly). weights is the combined
+    mask*loss_weights tensor, already shifted.
+    """
     B, S, H = hidden.shape
     c = max(1, min(chunk_size, S))
     while S % c:
         c -= 1
     n = S // c
-
-    weights = jnp.ones((B, S), dtype=jnp.float32)
-    if loss_mask is not None:
-        weights = weights * loss_mask.astype(jnp.float32)
-    if loss_weights is not None:
-        weights = weights * loss_weights.astype(jnp.float32)
 
     # [B, S, ...] → [n, B, c, ...] scan layout.
     def to_chunks(x):
@@ -143,24 +179,22 @@ def fused_lm_head_cross_entropy(
         deltas = chunk_stats(embedding, h_c, l_c, w_c)
         return tuple(a + d for a, d in zip(carry, deltas)), None
 
-    zeros = (jnp.float32(0.0),) * 4
+    # The scan carry must match the body output's varying-manual-axes type
+    # when this runs inside a shard_map manual region (the 1F1B pipeline
+    # calls it per microbatch under axis 'pipe'). A data-derived zero
+    # inherits the union of the operands' varying axes; outside manual
+    # regions it folds to a plain 0.
+    zero = (
+        hidden.reshape(-1)[0].astype(jnp.float32) * 0.0
+        + embedding.reshape(-1)[0].astype(jnp.float32) * 0.0
+        + weights.reshape(-1)[0] * 0.0
+        + labels.reshape(-1)[0].astype(jnp.float32) * 0.0
+    )
+    zeros = (zero,) * 4
     (nll_sum, w_sum, z_sum, n_tok), _ = jax.lax.scan(
         body, zeros, (h_chunks, l_chunks, w_chunks)
     )
-
-    denom = jnp.maximum(w_sum, 1.0)
-    loss = nll_sum / denom
-    metrics = {
-        "ce_loss": loss,
-        "perplexity": jnp.exp(jnp.clip(loss, max=20.0)),
-        "tokens_in_loss": n_tok,
-    }
-    if z_loss_weight > 0.0:
-        z = z_sum / denom * z_loss_weight
-        loss = loss + z
-        metrics["z_loss"] = z
-    metrics["total_loss"] = loss
-    return loss, metrics
+    return nll_sum, w_sum, z_sum, n_tok
 
 
 def global_norm(grads) -> jax.Array:

@@ -28,6 +28,7 @@ tp/pp/dp/sp/ep contract.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -60,6 +61,15 @@ def pipeline_compatible(config: Config) -> Tuple[bool, str]:
             "segments); use moe_pattern 'all' or 'none'"
         )
     return True, ""
+
+
+def _is_expert_leaf(path) -> bool:
+    """Stack-param leaves whose dim 1 (after the layer axis) is the expert
+    dim — the MoE module's wi/wo. Everything else (attention — which has
+    its own 'wo' — norms, router) is replicated over 'expert'."""
+    name = getattr(path[-1], "key", None)
+    parent = getattr(path[-2], "key", None) if len(path) >= 2 else None
+    return parent == "moe" and name in ("wi", "wo")
 
 
 def _stage_apply(
@@ -123,9 +133,13 @@ def make_pipeline_loss_fn(
     n_micro = config.pipeline_microbatches or Pn
     dtype = model.dtype
     # Representative block: homogeneity was checked, so layer 0's kind
-    # (and param structure) matches every layer.
+    # (and param structure) matches every layer. Expert-axis activation
+    # constraints are dropped inside the manual region (partitioner
+    # group-check crash); the expert-sharded weights still partition the
+    # expert einsums.
     block = TransformerBlock(
-        config, layer_idx=0, dtype=dtype, deterministic=deterministic
+        dataclasses.replace(config, moe_ep_constraints=False),
+        layer_idx=0, dtype=dtype, deterministic=deterministic,
     )
 
     from luminaai_tpu.models.layers import Embedder, RMSNorm
@@ -237,6 +251,546 @@ def make_pipeline_loss_fn(
     return loss_fn
 
 
+def make_1f1b_loss_fn(config: Config, model, mesh: Mesh) -> Callable:
+    """1F1B (PipeDream-flush) pipeline: fwd and bwd interleaved in ONE
+    lockstep tick scan, gradients accumulated in the scan carry.
+
+    Why not autodiff through the schedule (the GPipe path): reversing the
+    tick scan keeps every microbatch's stage activations live until the
+    backward replays, so per-stage memory grows with n_micro. Here the
+    last stage computes the fused CE for each microbatch the moment it
+    exits (the loss lives INSIDE the pipelined region), so its cotangent
+    flows back up while later microbatches are still going forward; a
+    stage input can be dropped after its bwd tick, bounding the saved-
+    activation ring at min(n_micro, 2P-1) microbatch inputs per stage.
+
+    Timetable (stage p, microbatch m, P stages): fwd at tick m+p, bwd at
+    tick m + 2P-1-p; T = n_micro + 2P-1 ticks. Steady state does one fwd
+    and one bwd per tick ("one forward, one backward"). Activations hop
+    down (ppermute +1) and cotangents hop up (ppermute -1) every tick.
+    Each bwd tick re-runs the stage forward under jax.vjp from the saved
+    input (rematerialization), computing embed (stage 0), the stage
+    layers, and final-norm + CE-sums (last stage) in one structurally
+    uniform function — the p-dependent parts are selected by masks, so
+    all stages trace the same graph and the dead branches contribute
+    exact-zero gradients.
+
+    Exactness: the CE is accumulated in token-SUM form and divided by the
+    global weight total (precomputed from the full batch), and aux losses
+    get cotangent 1/n_micro — identical math to the non-pipelined step,
+    so losses and grads match it to numerics. The train step still calls
+    jax.value_and_grad: a custom_vjp runs the fused schedule in its
+    forward and stashes the already-computed grads as residuals.
+    """
+    ok, why = pipeline_compatible(config)
+    if not ok:
+        raise ValueError(f"config not pipeline-compatible: {why}")
+    assert config.fused_lm_head_ce, (
+        "pipeline train step requires fused_lm_head_ce"
+    )
+    from luminaai_tpu.ops.fused import fused_lm_head_ce_sums
+
+    Pn = config.pipeline_parallel_size
+    L = config.num_layers
+    n_local = L // Pn
+    n_micro = config.pipeline_microbatches or Pn
+    R = min(n_micro, 2 * Pn - 1)  # saved-input ring slots per stage
+    T = n_micro + 2 * Pn - 1
+    zw = config.z_loss_weight
+    dtype = model.dtype
+    # Expert parallelism composes MANUALLY here: the 'expert' axis joins
+    # the manual region, microbatch tokens are sharded over it (ep borrows
+    # the data dimension), and MoELayer runs tiled all-to-alls around its
+    # local experts (models/moe.py moe_manual_ep).
+    ep = config.expert_parallel_size
+    manual_axes = ("pipe", "expert") if ep > 1 else ("pipe",)
+    block = TransformerBlock(
+        dataclasses.replace(
+            config, moe_ep_constraints=False, moe_manual_ep=ep > 1
+        ),
+        layer_idx=0, dtype=dtype, deterministic=False,
+    )
+
+    from luminaai_tpu.models.layers import Embedder, RMSNorm
+
+    embedder = Embedder(config, dtype=dtype, name=None)
+    final_norm = RMSNorm(config.rms_norm_eps, dtype=dtype)
+    head_name = "embedding" if config.tie_word_embeddings else "lm_head"
+
+    def schedule_body(stack_local, io, ids_mb, lab_mb, wts_mb, rng, w_total):
+        """Manual over 'pipe'. ids/lab/wts arrive pre-split [n_micro, mb, S];
+        w_total is the global CE weight sum (denominator)."""
+        p = jax.lax.axis_index("pipe")
+        is_last = p == Pn - 1
+        first_layer = p * n_local
+        mb, S = ids_mb.shape[1], ids_mb.shape[2]
+        H = config.hidden_size
+        fwd_perm = [(i, (i + 1) % Pn) for i in range(Pn)]
+        bwd_perm = [(i, (i - 1) % Pn) for i in range(Pn)]
+
+        def full_fn(stack, io_, x_recv, ids, lab, wts, m_idx):
+            """Embed (stage 0) → stage layers → final norm + CE sums (last
+            stage). Uniform across stages; masks route the cotangents."""
+            emb_x = embedder.apply(
+                {"params": io_["embedder"]}, ids, method="encode"
+            )
+            x_in = jnp.where(p == 0, emb_x, x_recv)
+            h, metrics = _stage_apply(
+                config, block, stack, x_in,
+                jax.random.fold_in(rng, m_idx), n_local, first_layer,
+            )
+            nh = final_norm.apply({"params": io_["final_norm"]}, h)
+            emb_head = io_["embedder"][head_name]
+            if isinstance(emb_head, nn.meta.AxisMetadata):
+                emb_head = emb_head.unbox()
+            nll_s, w_s, z_s, n_tok = fused_lm_head_ce_sums(
+                nh, emb_head, lab, wts,
+                label_smoothing=config.label_smoothing,
+                chunk_size=config.loss_chunk_size,
+            )
+            ce_scalar = nll_s + zw * z_s
+            # nll_s * 0: a zero carrying the same varying-axes type as the
+            # CE outputs, so the cotangent types line up even when the
+            # block metrics dict is empty (dense stacks).
+            aux_scalar = nll_s * 0.0
+            for key, v in metrics.items():
+                if key.endswith("_loss"):
+                    aux_scalar = aux_scalar + v
+            return (h, ce_scalar, aux_scalar), (metrics, nll_s, w_s, z_s, n_tok)
+
+        def fwd_only(stack, io_, x_recv, ids, m_idx):
+            emb_x = embedder.apply(
+                {"params": io_["embedder"]}, ids, method="encode"
+            )
+            x_in = jnp.where(p == 0, emb_x, x_recv)
+            h, _ = _stage_apply(
+                config, block, stack, x_in,
+                jax.random.fold_in(rng, m_idx), n_local, first_layer,
+            )
+            return h
+
+        def varying(a):
+            """Upcast to varying over every manual axis (pcast rejects
+            axes a value already varies over)."""
+            need = tuple(
+                ax for ax in manual_axes if ax not in jax.typeof(a).vma
+            )
+            return jax.lax.pcast(a, need, to="varying") if need else a
+
+        vzeros = lambda tree: jax.tree.map(
+            lambda x: varying(jnp.zeros(x.shape, jnp.float32)), tree
+        )
+        act0 = varying(jnp.zeros((mb, S, H), dtype))
+        m_shape = jax.eval_shape(
+            lambda: full_fn(
+                stack_local, io, act0, ids_mb[0], lab_mb[0], wts_mb[0], 0
+            )[1][0]
+        )
+        carry0 = dict(
+            act_send=act0,
+            g_send=varying(jnp.zeros((mb, S, H), jnp.float32)),
+            saved=varying(jnp.zeros((R, mb, S, H), dtype)),
+            g_stack=vzeros(stack_local),
+            g_io=vzeros(io),
+            ce={
+                k: varying(jnp.float32(0.0))
+                for k in ("nll", "w", "z", "n_tok")
+            },
+            macc=vzeros(m_shape),
+        )
+
+        def one_tick(carry, t):
+            recv_act = jax.lax.ppermute(carry["act_send"], "pipe", fwd_perm)
+            recv_g = jax.lax.ppermute(carry["g_send"], "pipe", bwd_perm)
+
+            # ---- backward work (reads the ring BEFORE this tick's store)
+            m_b = t - (2 * Pn - 1 - p)
+            bwd_valid = (m_b >= 0) & (m_b < n_micro)
+            mb_idx = jnp.clip(m_b, 0, n_micro - 1)
+            x_saved = jax.lax.dynamic_index_in_dim(
+                carry["saved"], mb_idx % R, axis=0, keepdims=False
+            )
+            ids_b = jax.lax.dynamic_index_in_dim(ids_mb, mb_idx, 0, False)
+            lab_b = jax.lax.dynamic_index_in_dim(lab_mb, mb_idx, 0, False)
+            wts_b = jax.lax.dynamic_index_in_dim(wts_mb, mb_idx, 0, False)
+            _, vjp_fn, aux = jax.vjp(
+                lambda st, io_, xr: full_fn(
+                    st, io_, xr, ids_b, lab_b, wts_b, mb_idx
+                ),
+                stack_local, io, x_saved, has_aux=True,
+            )
+            metrics_b, nll_s, w_s, z_s, n_tok = aux
+            live = bwd_valid.astype(jnp.float32)
+            # varying(): cotangent VMA types must match the primals', which
+            # vary over every manual axis; these masks only derive from the
+            # pipe index.
+            g_h = varying(
+                (jnp.where(is_last, 0.0, recv_g) * live).astype(dtype)
+            )
+            g_ce = varying(jnp.where(is_last, live / w_total, jnp.float32(0.0)))
+            g_aux = varying(live / jnp.float32(n_micro))
+            g_stack_c, g_io_c, g_x = vjp_fn((g_h, g_ce, g_aux))
+            acc = lambda a, g: jax.tree.map(
+                lambda x, y: x + y.astype(jnp.float32) * live, a, g
+            )
+            g_stack = acc(carry["g_stack"], g_stack_c)
+            g_io = acc(carry["g_io"], g_io_c)
+            last_live = live * is_last.astype(jnp.float32)
+            ce = carry["ce"]
+            ce = dict(
+                nll=ce["nll"] + nll_s * last_live,
+                w=ce["w"] + w_s * last_live,
+                z=ce["z"] + z_s * last_live,
+                n_tok=ce["n_tok"] + n_tok * last_live,
+            )
+            macc = jax.tree.map(
+                lambda a, m: a + m.astype(jnp.float32) * live,
+                carry["macc"], metrics_b,
+            )
+
+            # ---- forward work
+            m_f = t - p
+            fwd_valid = (m_f >= 0) & (m_f < n_micro)
+            mf_idx = jnp.clip(m_f, 0, n_micro - 1)
+            ids_f = jax.lax.dynamic_index_in_dim(ids_mb, mf_idx, 0, False)
+            out_f = fwd_only(stack_local, io, recv_act, ids_f, mf_idx)
+            saved = jnp.where(
+                fwd_valid,
+                jax.lax.dynamic_update_index_in_dim(
+                    carry["saved"], recv_act.astype(dtype), mf_idx % R, 0
+                ),
+                carry["saved"],
+            )
+            return dict(
+                act_send=out_f,
+                g_send=g_x.astype(jnp.float32),
+                saved=saved,
+                g_stack=g_stack,
+                g_io=g_io,
+                ce=ce,
+                macc=macc,
+            ), None
+
+        carry, _ = jax.lax.scan(one_tick, carry0, jnp.arange(T))
+        # Cross-stage reductions: CE sums live on the last stage, io grads
+        # and layer metrics are per-stage partials, stack grads stay
+        # stage-local (they ARE the pipe-sharded grad). Under manual ep,
+        # token-sharded paths make io/ce/non-expert-stack grads partial
+        # over 'expert' too (psum), while wi/wo grads are already total
+        # (post-all-to-all experts see every shard's tokens) and stay
+        # local; MoE metrics were pmean'd inside the layer, so macc takes
+        # a pmean over 'expert' rather than double-counting.
+        g_io = jax.tree.map(
+            lambda g: jax.lax.psum(g, manual_axes), carry["g_io"]
+        )
+        ce = jax.tree.map(
+            lambda v: jax.lax.psum(v, manual_axes), carry["ce"]
+        )
+        macc = jax.tree.map(lambda v: jax.lax.psum(v, "pipe"), carry["macc"])
+        g_stack = carry["g_stack"]
+        if ep > 1:
+            macc = jax.tree.map(
+                lambda v: jax.lax.pmean(v, "expert"), macc
+            )
+            g_stack = jax.tree_util.tree_map_with_path(
+                lambda pth, g: (
+                    g if _is_expert_leaf(pth)
+                    else jax.lax.psum(g, "expert")
+                ),
+                g_stack,
+            )
+        return g_stack, g_io, ce, macc
+
+    def loss_fn(params, batch: Batch, rng: jax.Array):
+        ids = batch["input_ids"]
+        B, S = ids.shape
+        mb = B // n_micro
+        labels, valid = shift_labels(batch)
+        mask, weights = _shifted_mask_weights(batch, valid)
+        wts = mask if weights is None else mask * weights
+        wts = wts.astype(jnp.float32)
+        w_total = jnp.maximum(wts.sum(), 1.0)
+        split = lambda x: x.reshape(n_micro, mb, S)
+        ids_mb, lab_mb, wts_mb = split(ids), split(labels), split(wts)
+
+        stack = params["scan_0"]["block_0"]
+        io = {
+            "embedder": params["embedder"],
+            "final_norm": params["final_norm"],
+        }
+        # Replicate the io params over every auto mesh axis before entering
+        # the manual region: embed-encode and the fused CE run INSIDE the
+        # 1F1B schedule, and XLA's SPMD partitioner check-fails when it has
+        # to group the tensor/fsdp collectives those ops would need inside
+        # a partial-manual shard_map (spmd_partitioner_util.cc:495). The
+        # all-gather happens once per step out here; CE compute is
+        # replicated across tensor shards (same trade GPipe makes across
+        # pipe shards by running CE outside).
+        io = jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(
+                x, jax.NamedSharding(mesh, P())
+            ),
+            io,
+        )
+
+        stack_specs = jax.tree_util.tree_map_with_path(
+            lambda pth, x: (
+                P("pipe", "expert")
+                if ep > 1 and _is_expert_leaf(pth)
+                else P("pipe")
+            ),
+            stack,
+        )
+        # Tokens shard over 'expert' on the microbatch dim when ep > 1.
+        mb_spec = P(None, "expert") if ep > 1 else P()
+        sharded = jax.shard_map(
+            schedule_body,
+            mesh=mesh,
+            axis_names=frozenset(manual_axes),
+            in_specs=(
+                stack_specs, P(), mb_spec, mb_spec, mb_spec, P(), P(),
+            ),
+            out_specs=(stack_specs, P(), P(), P()),
+        )
+
+        def run(stack_, io_):
+            g_stack, g_io, ce, macc = sharded(
+                stack_, io_, ids_mb, lab_mb, wts_mb, rng, w_total
+            )
+            denom = jnp.maximum(ce["w"], 1.0)
+            ce_loss = ce["nll"] / denom
+            metrics = {
+                "ce_loss": ce_loss,
+                "perplexity": jnp.exp(jnp.clip(ce_loss, max=20.0)),
+                "tokens_in_loss": ce["n_tok"],
+            }
+            total = ce_loss
+            if zw > 0.0:
+                z = ce["z"] / denom * zw
+                total = total + z
+                metrics["z_loss"] = z
+            metrics["total_loss"] = total
+            aux_total = jnp.float32(0.0)
+            for key, v in macc.items():
+                if key.endswith("_loss"):
+                    per_mb = v / n_micro
+                    metrics[key] = per_mb
+                    aux_total = aux_total + per_mb
+                else:
+                    metrics[key] = v / (L * n_micro)
+            total = total + aux_total
+            metrics["loss"] = total
+            metrics["aux_loss"] = aux_total
+            return total, metrics, g_stack, g_io
+
+        @jax.custom_vjp
+        def f(stack_, io_):
+            loss, metrics, _, _ = run(stack_, io_)
+            return loss, metrics
+
+        def f_fwd(stack_, io_):
+            loss, metrics, g_stack, g_io = run(stack_, io_)
+            return (loss, metrics), (g_stack, g_io)
+
+        def f_bwd(res, cts):
+            g_stack, g_io = res
+            g_loss = cts[0]
+            scale = lambda t: jax.tree.map(lambda g: g * g_loss, t)
+            return scale(g_stack), scale(g_io)
+
+        f.defvjp(f_fwd, f_bwd)
+        return f(stack, io)
+
+    return loss_fn
+
+
+def make_pipeline_fwd_metrics_fn(config: Config, model, mesh: Mesh) -> Callable:
+    """Forward-only pipeline eval: deterministic routing, CE computed at
+    the last stage inside the region (same manual machinery as the 1F1B
+    train loss, minus the backward) — so it supports every mesh the train
+    path does, including manual expert parallelism."""
+    ok, why = pipeline_compatible(config)
+    if not ok:
+        raise ValueError(f"config not pipeline-compatible: {why}")
+    assert config.fused_lm_head_ce, (
+        "pipeline eval requires fused_lm_head_ce"
+    )
+    from luminaai_tpu.ops.fused import fused_lm_head_ce_sums
+
+    Pn = config.pipeline_parallel_size
+    L = config.num_layers
+    n_local = L // Pn
+    n_micro = config.pipeline_microbatches or Pn
+    T = n_micro + Pn - 1
+    zw = config.z_loss_weight
+    dtype = model.dtype
+    ep = config.expert_parallel_size
+    manual_axes = ("pipe", "expert") if ep > 1 else ("pipe",)
+    block = TransformerBlock(
+        dataclasses.replace(
+            config, moe_ep_constraints=False, moe_manual_ep=ep > 1
+        ),
+        layer_idx=0, dtype=dtype, deterministic=True,
+    )
+
+    from luminaai_tpu.models.layers import Embedder, RMSNorm
+
+    embedder = Embedder(config, dtype=dtype, name=None)
+    final_norm = RMSNorm(config.rms_norm_eps, dtype=dtype)
+    head_name = "embedding" if config.tie_word_embeddings else "lm_head"
+
+    def schedule_body(stack_local, io, ids_mb, lab_mb, wts_mb, rng):
+        p = jax.lax.axis_index("pipe")
+        is_last = p == Pn - 1
+        first_layer = p * n_local
+        mb, S = ids_mb.shape[1], ids_mb.shape[2]
+        H = config.hidden_size
+        fwd_perm = [(i, (i + 1) % Pn) for i in range(Pn)]
+
+        def fwd_ce(x_recv, ids, lab, wts, m_idx):
+            emb_x = embedder.apply(
+                {"params": io["embedder"]}, ids, method="encode"
+            )
+            x_in = jnp.where(p == 0, emb_x, x_recv)
+            h, metrics = _stage_apply(
+                config, block, stack_local, x_in,
+                jax.random.fold_in(rng, m_idx), n_local, first_layer,
+            )
+            nh = final_norm.apply({"params": io["final_norm"]}, h)
+            emb_head = io["embedder"][head_name]
+            if isinstance(emb_head, nn.meta.AxisMetadata):
+                emb_head = emb_head.unbox()
+            sums = fused_lm_head_ce_sums(
+                nh, emb_head, lab, wts,
+                label_smoothing=config.label_smoothing,
+                chunk_size=config.loss_chunk_size,
+            )
+            return h, sums, metrics
+
+        def varying(a):
+            need = tuple(
+                ax for ax in manual_axes if ax not in jax.typeof(a).vma
+            )
+            return jax.lax.pcast(a, need, to="varying") if need else a
+
+        act0 = varying(jnp.zeros((mb, S, H), dtype))
+        m_shape = jax.eval_shape(
+            lambda: fwd_ce(act0, ids_mb[0], lab_mb[0], wts_mb[0], 0)[2]
+        )
+        carry0 = dict(
+            act_send=act0,
+            ce={
+                k: varying(jnp.float32(0.0))
+                for k in ("nll", "w", "z", "n_tok")
+            },
+            macc=jax.tree.map(
+                lambda s: varying(jnp.zeros(s.shape, jnp.float32)), m_shape
+            ),
+        )
+
+        def one_tick(carry, t):
+            recv_act = jax.lax.ppermute(carry["act_send"], "pipe", fwd_perm)
+            m_f = t - p
+            valid = (m_f >= 0) & (m_f < n_micro)
+            mf_idx = jnp.clip(m_f, 0, n_micro - 1)
+            ids_f = jax.lax.dynamic_index_in_dim(ids_mb, mf_idx, 0, False)
+            lab_f = jax.lax.dynamic_index_in_dim(lab_mb, mf_idx, 0, False)
+            wts_f = jax.lax.dynamic_index_in_dim(wts_mb, mf_idx, 0, False)
+            out_f, sums, metrics = fwd_ce(recv_act, ids_f, lab_f, wts_f, mf_idx)
+            live = valid.astype(jnp.float32)
+            last_live = live * is_last.astype(jnp.float32)
+            nll_s, w_s, z_s, n_tok = sums
+            ce = carry["ce"]
+            ce = dict(
+                nll=ce["nll"] + nll_s * last_live,
+                w=ce["w"] + w_s * last_live,
+                z=ce["z"] + z_s * last_live,
+                n_tok=ce["n_tok"] + n_tok * last_live,
+            )
+            macc = jax.tree.map(
+                lambda a, m: a + m.astype(jnp.float32) * live,
+                carry["macc"], metrics,
+            )
+            return dict(act_send=out_f, ce=ce, macc=macc), None
+
+        carry, _ = jax.lax.scan(one_tick, carry0, jnp.arange(T))
+        ce = jax.tree.map(
+            lambda v: jax.lax.psum(v, manual_axes), carry["ce"]
+        )
+        macc = jax.tree.map(lambda v: jax.lax.psum(v, "pipe"), carry["macc"])
+        if ep > 1:
+            macc = jax.tree.map(lambda v: jax.lax.pmean(v, "expert"), macc)
+        return ce, macc
+
+    def eval_loss(params, batch: Batch):
+        ids = batch["input_ids"]
+        B, S = ids.shape
+        mb = B // n_micro
+        labels, valid = shift_labels(batch)
+        mask, weights = _shifted_mask_weights(batch, valid)
+        wts = mask if weights is None else mask * weights
+        wts = wts.astype(jnp.float32)
+        split = lambda x: x.reshape(n_micro, mb, S)
+
+        stack = params["scan_0"]["block_0"]
+        io = {
+            "embedder": params["embedder"],
+            "final_norm": params["final_norm"],
+        }
+        io = jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(
+                x, jax.NamedSharding(mesh, P())
+            ),
+            io,
+        )
+        stack_specs = jax.tree_util.tree_map_with_path(
+            lambda pth, x: (
+                P("pipe", "expert")
+                if ep > 1 and _is_expert_leaf(pth)
+                else P("pipe")
+            ),
+            stack,
+        )
+        mb_spec = P(None, "expert") if ep > 1 else P()
+        sharded = jax.shard_map(
+            schedule_body,
+            mesh=mesh,
+            axis_names=frozenset(manual_axes),
+            in_specs=(stack_specs, P(), mb_spec, mb_spec, mb_spec, P()),
+            out_specs=(P(), P()),
+        )
+        ce, macc = sharded(
+            stack, io, split(ids), split(labels), split(wts),
+            jax.random.key(0),
+        )
+        denom = jnp.maximum(ce["w"], 1.0)
+        ce_loss = ce["nll"] / denom
+        metrics = {
+            "ce_loss": ce_loss,
+            "perplexity": jnp.exp(jnp.clip(ce_loss, max=20.0)),
+            "tokens_in_loss": ce["n_tok"],
+        }
+        total = ce_loss
+        if zw > 0.0:
+            z = ce["z"] / denom * zw
+            total = total + z
+            metrics["z_loss"] = z
+        metrics["total_loss"] = total
+        aux_total = jnp.float32(0.0)
+        for key, v in macc.items():
+            if key.endswith("_loss"):
+                per_mb = v / n_micro
+                metrics[key] = per_mb
+                aux_total = aux_total + per_mb
+            else:
+                metrics[key] = v / (L * n_micro)
+        metrics["loss"] = total + aux_total
+        metrics["aux_loss"] = aux_total
+        return metrics
+
+    return eval_loss
+
+
 def make_pipeline_train_step(
     config: Config,
     model,
@@ -245,19 +799,24 @@ def make_pipeline_train_step(
     schedule: Optional[optax.Schedule],
     tx: optax.GradientTransformation,
 ):
-    """Donated, sharded, jitted GPipe train step.
+    """Donated, sharded, jitted pipeline train step (1F1B or GPipe per
+    config.pipeline_schedule).
 
     Same contract as parallel.train_step.make_train_step — in fact it IS
-    that step builder with the GPipe loss injected (grad accumulation is
-    validated to 1 under pp, so the shared body's accumulation path
+    that step builder with the pipeline loss injected (grad accumulation
+    is validated to 1 under pp, so the shared body's accumulation path
     degenerates to a single value_and_grad; clipping, donation, and metric
     reporting stay single-sourced).
     """
     from luminaai_tpu.parallel.train_step import make_train_step
 
+    if config.pipeline_schedule == "1f1b":
+        loss_fn = make_1f1b_loss_fn(config, model, mesh)
+    else:
+        loss_fn = make_pipeline_loss_fn(config, model, mesh)
     return make_train_step(
         config, model, state_shardings, mesh, schedule, tx,
-        loss_fn=make_pipeline_loss_fn(config, model, mesh),
+        loss_fn=loss_fn,
     )
 
 
@@ -267,18 +826,25 @@ def make_pipeline_eval_step(
     state_shardings: TrainState,
     mesh: Mesh,
 ):
-    """Forward-only eval over the GPipe schedule (deterministic routing) —
-    the non-pipelined eval step would all-gather every stage's layers onto
-    every device per scan iteration. Reuses make_eval_step's wrapper with
-    the GPipe loss injected (mirror of the train-step delegation)."""
+    """Forward-only eval over the pipeline schedule (deterministic
+    routing) — the non-pipelined eval step would all-gather every stage's
+    layers onto every device per scan iteration. Under the 1F1B schedule
+    the eval loss shares its in-region CE machinery (and so composes with
+    manual expert parallelism); the GPipe schedule keeps its
+    autodiff-free forward loss."""
     from luminaai_tpu.parallel.train_step import make_eval_step
 
-    pipe_loss = make_pipeline_loss_fn(config, model, mesh, deterministic=True)
-    fixed_rng = jax.random.key(0)  # deterministic path ignores it
+    if config.pipeline_schedule == "1f1b":
+        eval_loss = make_pipeline_fwd_metrics_fn(config, model, mesh)
+    else:
+        pipe_loss = make_pipeline_loss_fn(
+            config, model, mesh, deterministic=True
+        )
+        fixed_rng = jax.random.key(0)  # deterministic path ignores it
 
-    def eval_loss(params, batch):
-        _, metrics = pipe_loss(params, batch, fixed_rng)
-        return metrics
+        def eval_loss(params, batch):
+            _, metrics = pipe_loss(params, batch, fixed_rng)
+            return metrics
 
     return make_eval_step(
         config, model, state_shardings, mesh, loss_fn=eval_loss

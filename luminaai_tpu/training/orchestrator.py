@@ -760,18 +760,20 @@ class AdaptiveTrainingOrchestrator:
             # Slow sustained loss rise that never trips the spike/divergence
             # rules above: add regularization (ref trainer.py:1792's stated
             # use: adapting weight decay to training phase / overfitting).
+            # Gate and base read the TRAINER's config — the object the
+            # intervention mutates (self.config may be a caller copy).
+            wd_now = self.trainer.config.weight_decay
             traj = self.analytics.predict_training_trajectory()
             if (
                 traj is not None
                 and traj["prediction"] == "potential_divergence"
-                and self.config.weight_decay < 0.1
+                and wd_now < 0.1
             ):
                 return AdaptiveDecision(
                     kind="weight_decay",
                     params={
                         "new_value": round(
-                            min(0.1, max(self.config.weight_decay, 0.005) * 2),
-                            4,
+                            min(0.1, max(wd_now, 0.005) * 2), 4
                         )
                     },
                     reason=(
