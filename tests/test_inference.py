@@ -184,3 +184,31 @@ def test_chat_from_checkpoint(tmp_path):
     assert isinstance(text, str) and chat.stats.messages == 1
     assert chat.handle_command("/mode precise") == "mode -> precise"
     assert "messages: 1" in chat.handle_command("/stats")
+
+
+def test_generate_batch_matches_single_greedy(setup):
+    """Batched decode is vmap lanes of the single-sequence machinery:
+    under greedy sampling each row must reproduce the single-stream
+    output exactly (ragged prompt lengths included)."""
+    engine = setup[0]
+    prompts = [[5, 6, 7], [9, 10, 11, 12, 13, 14], [20]]
+    batch = engine.generate_batch(
+        prompts, temperature=0.0, max_new_tokens=8, seed=0
+    )
+    assert len(batch) == 3
+    for p, (toks, st) in zip(prompts, batch):
+        single, _ = engine.generate(
+            p, temperature=0.0, max_new_tokens=8, seed=0
+        )
+        assert toks == single, (p, toks, single)
+        assert st["batch_size"] == 3
+        assert st["prompt_tokens"] == len(p)
+
+
+def test_generate_batch_single_row_delegates(setup):
+    engine = setup[0]
+    out = engine.generate_batch([[7, 8, 9]], temperature=0.0,
+                                max_new_tokens=4, seed=0)
+    single, _ = engine.generate([7, 8, 9], temperature=0.0,
+                                max_new_tokens=4, seed=0)
+    assert out[0][0] == single

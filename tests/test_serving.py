@@ -25,9 +25,9 @@ class FakeTokenizer:
 
 
 class FakeEngine:
-    """Engine double mirroring GenerationEngine's contract: generate()
-    maps token ids -> (token ids, stats); chat_response maps messages ->
-    (text, stats); .tokenizer does the text round-trip."""
+    """Engine double mirroring GenerationEngine's contract: generate /
+    generate_batch map token ids -> (token ids, stats); encode_chat maps
+    messages -> prompt ids; .tokenizer does the text round-trip."""
 
     def __init__(self):
         self.config = Config(
@@ -35,16 +35,23 @@ class FakeEngine:
             num_kv_heads=2, seq_length=64, use_flash_attention=False,
         )
         self.tokenizer = FakeTokenizer()
+        self.batch_sizes = []
 
-    def generate(self, prompt_tokens):
+    def generate(self, prompt_tokens, **kw):
         return list(prompt_tokens)[:3], {
             "tokens_generated": 3, "stopped": "eos",
         }
 
+    def generate_batch(self, prompts, **kw):
+        self.batch_sizes.append(len(prompts))
+        return [self.generate(p, **kw) for p in prompts]
+
+    def encode_chat(self, messages):
+        return self.tokenizer.backend.encode(messages[-1]["content"])
+
     def chat_response(self, messages):
-        return f"reply to {messages[-1]['content']}", {
-            "tokens_generated": 2, "stopped": "eos",
-        }
+        reply, stats = self.generate(self.encode_chat(messages))
+        return self.tokenizer.decode(reply), stats
 
 
 @pytest.fixture()
@@ -89,9 +96,10 @@ def test_generate_and_stats(server_url):
     assert code == 200 and body["text"].startswith("tok:")
     assert body["tokens"] == 3
     code, body = _post(url, "/v1/chat", {"message": "yo"})
-    assert code == 200 and body["reply"] == "reply to yo"
+    # Chat rides the same batched path: encode_chat -> generate -> decode.
+    assert code == 200 and body["reply"] == "tok:121,111"
     code, body = _get(url, "/stats")
-    assert body["requests"] == 2 and body["tokens_out"] == 5
+    assert body["requests"] == 2 and body["tokens_out"] == 6
 
 
 def test_bad_requests(server_url):
@@ -168,3 +176,72 @@ def test_malformed_chat_messages(server_url):
     url, _ = server_url
     code, body = _post(url, "/v1/chat", {"messages": [{"content": "hi"}]})
     assert code == 400 and "role" in body["error"]
+
+
+def test_concurrent_requests_ride_one_batch():
+    """N clients in flight together must be served by batched decode
+    (MicroBatcher groups same-param requests within the window)."""
+    srv = ChatServer(FakeEngine(), batch_window_ms=300, max_batch=8)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), srv.make_handler())
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        codes = []
+        lock = threading.Lock()
+
+        def hit(i):
+            code, body = _post(url, "/v1/generate", {"prompt": f"hey{i}"})
+            with lock:
+                codes.append(code)
+
+        threads = [
+            threading.Thread(target=hit, args=(i,)) for i in range(6)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert codes == [200] * 6
+        assert max(srv.engine.batch_sizes, default=1) >= 2, (
+            srv.engine.batch_sizes
+        )
+        _, stats = _get(url, "/stats")
+        assert stats["max_batch_seen"] >= 2
+        assert stats["requests"] == 6
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_mismatched_params_requeue_not_starve():
+    """Requests with different sampling params fall into separate batches
+    but all complete."""
+    srv = ChatServer(FakeEngine(), batch_window_ms=100, max_batch=8)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), srv.make_handler())
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        codes = []
+        lock = threading.Lock()
+
+        def hit(i):
+            code, _ = _post(
+                url, "/v1/generate",
+                {"prompt": "z", "temperature": 0.1 * (i % 2)},
+            )
+            with lock:
+                codes.append(code)
+
+        threads = [
+            threading.Thread(target=hit, args=(i,)) for i in range(4)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert codes == [200] * 4
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
