@@ -125,6 +125,9 @@ class Config:
     # Adam first-moment dtype: None = fp32; 'bf16' halves mu's HBM
     # (2 bytes/param) — nu stays fp32 (variance needs the exponent range).
     adam_mu_dtype: Optional[str] = None
+    # 'int8': both Adam moments as int8 codes + row-wise scales (1B/param/
+    # moment vs 4; ref trainer.py:771 create_quantized_optimizer).
+    adam_state_quantization: Optional[str] = None
     scan_layers: bool = False  # lax.scan over layers (homogeneous stacks)
     donate_state: bool = True
     eval_every_n_batches: int = 500
@@ -339,6 +342,12 @@ class Config:
         assert self.adam_mu_dtype in (None, "bf16"), (
             f"invalid adam_mu_dtype {self.adam_mu_dtype}"
         )
+        assert self.adam_state_quantization in (None, "int8"), (
+            f"invalid adam_state_quantization {self.adam_state_quantization}"
+        )
+        assert not (
+            self.adam_state_quantization and self.adam_mu_dtype
+        ), "adam_state_quantization supersedes adam_mu_dtype; set one"
         for axis in ("fsdp", "expert", "tensor", "sequence", "pipeline"):
             size = getattr(self, f"{axis}_parallel_size")
             assert size >= 1, f"{axis}_parallel_size must be >= 1"

@@ -248,6 +248,12 @@ class GenerationEngine:
             ),
         )
 
+    def _trim_prompt(self, prompt, max_new: int) -> List[int]:
+        """Keep the prompt tail that fits the context budget (ref :374)."""
+        max_prompt = self.max_context - max_new - 1
+        p = list(prompt)
+        return p[-max_prompt:] if len(p) > max_prompt else p
+
     # -- public API --------------------------------------------------------
     def generate(
         self,
@@ -266,10 +272,7 @@ class GenerationEngine:
         max_new = gen_key[0]
 
         t0 = time.time()
-        prompt = list(prompt_tokens)
-        max_prompt = self.max_context - max_new - 1
-        if len(prompt) > max_prompt:
-            prompt = prompt[-max_prompt:]  # keep the tail (ref :374)
+        prompt = self._trim_prompt(prompt_tokens, max_new)
         length = len(prompt)
         bucket = min(_bucket_len(length), self.max_context)
         ids = np.zeros((1, bucket), dtype=np.int32)
@@ -347,12 +350,13 @@ class GenerationEngine:
         if not prompts:
             return []
         if len(prompts) == 1:
-            return [
-                self.generate(
-                    prompts[0], max_new_tokens, temperature, top_p, top_k,
-                    repetition_penalty, seed,
-                )
-            ]
+            tokens, stats = self.generate(
+                prompts[0], max_new_tokens, temperature, top_p, top_k,
+                repetition_penalty, seed,
+            )
+            stats["batch_size"] = 1
+            stats["batch_tokens_per_second"] = stats["tokens_per_second"]
+            return [(tokens, stats)]
         gen_key = self._resolve_gen_key(
             max_new_tokens, temperature, top_p, top_k, repetition_penalty
         )
@@ -360,8 +364,7 @@ class GenerationEngine:
         t0 = time.time()
         B = len(prompts)
         lanes = _bucket_len(B, minimum=2)
-        max_prompt = self.max_context - max_new - 1
-        rows = [list(p)[-max_prompt:] for p in prompts]
+        rows = [self._trim_prompt(p, max_new) for p in prompts]
         lengths = [max(1, len(r)) for r in rows]
         bucket = min(_bucket_len(max(lengths)), self.max_context)
         ids = np.zeros((lanes, 1, bucket), dtype=np.int32)
@@ -427,6 +430,9 @@ class GenerationEngine:
                         "prompt_tokens": lengths[i],
                         "stopped": stopped,
                         "seconds": round(dt, 3),
+                        "tokens_per_second": round(
+                            len(tokens) / max(dt, 1e-9), 1
+                        ),
                         "batch_size": B,
                     },
                 )

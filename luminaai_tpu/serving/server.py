@@ -4,10 +4,11 @@ The reference deploys its chat model behind an HTTP backend
 (ref: Dockerfile.backend — Flask server on :5001 with a /health check,
 docker-compose.dev.yml wiring; the Electron desktop app in package.json
 talks to it). This is that surface, TPU-side: a ThreadingHTTPServer wrapping
-GenerationEngine (requests serialize onto the single jit'd decode loop via a
-lock — TPU decode is latency-bound, one stream at a time beats contention),
-with the security stack (auth, rate limiting, input validation) optional on
-the same endpoints.
+GenerationEngine. Concurrent requests with identical sampling parameters
+are grouped by a MicroBatcher worker into ONE batched decode
+(engine.generate_batch) — one chip step advances every in-flight stream —
+with the security stack (auth, rate limiting, input validation) optional
+on the same endpoints.
 
 Endpoints:
   GET  /health            liveness + model info (ref HEALTHCHECK contract)
@@ -63,7 +64,20 @@ class MicroBatcher:
     ) -> Tuple[List[int], Dict[str, Any]]:
         ev = threading.Event()
         slot: Dict[str, Any] = {}
-        key = tuple(sorted(gen_kwargs.items()))
+        resolve = getattr(self.engine, "_resolve_gen_key", None)
+        if resolve is not None:
+            # Group by the RESOLVED compile key, so a request passing an
+            # explicit config-default value still batches with one that
+            # omitted it.
+            key = resolve(
+                gen_kwargs.get("max_new_tokens"),
+                gen_kwargs.get("temperature"),
+                gen_kwargs.get("top_p"),
+                gen_kwargs.get("top_k"),
+                gen_kwargs.get("repetition_penalty"),
+            )
+        else:  # duck-typed engines without the helper
+            key = tuple(sorted(gen_kwargs.items()))
         self.q.put((prompt_tokens, key, gen_kwargs, ev, slot))
         ev.wait()
         if "error" in slot:

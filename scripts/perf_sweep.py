@@ -39,6 +39,16 @@ VARIANTS = {
         "remat_policy": "save_outs",
         "moe_dispatch": "gather",
     },
+    # int8 Adam moments: ~6GB of fp32 moment state drops to ~1.5GB —
+    # headroom for bigger batches (pair with b24/b32 once timed).
+    "q8": {"adam_state_quantization": "int8"},
+    "b24_q8_saveouts_gather": {
+        "batch_size": 24,
+        "micro_batch_size": None,
+        "remat_policy": "save_outs",
+        "moe_dispatch": "gather",
+        "adam_state_quantization": "int8",
+    },
 }
 
 names = sys.argv[1:] or ["base", "dots", "scan", "einsum"]

@@ -9,8 +9,13 @@ print("PROBE_OK", jax.devices()[0].platform)'
 while true; do
   if timeout 90 python -c "$PROBE" 2>/dev/null | grep -q "PROBE_OK tpu"; then
     echo "$(date -u +%FT%TZ) tunnel up, starting sweep" >> scripts/sweep_out.txt
-    timeout 3600 python scripts/perf_sweep.py base saveouts_gather gatherd saveouts chunk1024 b24_saveouts_gather mu16 scan >> scripts/sweep_out.txt 2>&1
+    timeout 4500 python scripts/perf_sweep.py base saveouts_gather gatherd saveouts chunk1024 b24_saveouts_gather mu16 q8 b24_q8_saveouts_gather scan >> scripts/sweep_out.txt 2>&1
     echo "$(date -u +%FT%TZ) sweep done rc=$?" >> scripts/sweep_out.txt
+    echo "$(date -u +%FT%TZ) bench_ops" >> scripts/sweep_out.txt
+    timeout 2400 python bench_ops.py >> scripts/sweep_out.txt 2>&1
+    echo "$(date -u +%FT%TZ) serve_bench" >> scripts/sweep_out.txt
+    timeout 1800 python scripts/serve_bench.py 2 4 8 >> scripts/sweep_out.txt 2>&1
+    echo "$(date -u +%FT%TZ) all done" >> scripts/sweep_out.txt
     exit 0
   fi
   echo "$(date -u +%FT%TZ) tunnel down" >> scripts/watcher_log.txt

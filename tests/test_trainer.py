@@ -165,3 +165,37 @@ def test_adam_mu_bf16_trains(tmp_path):
     updates, state = tx.update(grads, state, params)
     params = optax.apply_updates(params, updates)
     assert all(jnp.isfinite(l).all() for l in jax.tree.leaves(params))
+
+
+def test_adam_int8_state_loss_parity(tmp_path):
+    """adam_state_quantization='int8' (ref trainer.py:771
+    create_quantized_optimizer): moments live as int8 codes + row scales.
+    The loss trajectory must track fp32 moments closely on a real model,
+    and the persistent state must actually be int8."""
+
+    losses = {}
+    for name, kw in (
+        ("fp32", {}),
+        ("int8", {"adam_state_quantization": "int8"}),
+    ):
+        cfg = tiny_config(tmp_path / name, **kw)
+        t = Trainer(cfg, train_data=patterned_data(cfg),
+                    checkpoint_dir=str(tmp_path / name / "ckpt"))
+        batch = t._put(next(patterned_data(cfg)()))
+        run = []
+        for _ in range(40):
+            t.state, m = t.train_step(t.state, batch)
+            run.append(float(m["loss"]))
+        losses[name] = run
+        if name == "int8":
+            n_int8 = sum(
+                1 for l in jax.tree.leaves(t.state.opt_state)
+                if hasattr(l, "dtype") and l.dtype == jnp.int8
+            )
+            assert n_int8 > 0, "no int8 leaves in opt state"
+        t.close()
+    # Both must learn, and the quantized trajectory must stay close.
+    assert losses["int8"][-1] < 0.75 * losses["int8"][0], losses["int8"]
+    assert abs(losses["int8"][-1] - losses["fp32"][-1]) < max(
+        0.25, 0.15 * losses["fp32"][-1]
+    ), (losses["fp32"][-1], losses["int8"][-1])
