@@ -13,6 +13,7 @@ processing local dumps instead of crashing mid-pipeline.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import os
@@ -41,6 +42,22 @@ SOURCE_URLS: Dict[str, str] = {
         "https://api.stackexchange.com/2.3/questions?site=stackoverflow"
         "&tagged={tag}&pagesize={page_size}&filter=withbody"
     ),
+    # (ref multi_source_dataset.py:860 PubMed eutils, :1020 reddit as the
+    # OpenWebText-style source, :1134 OpenAlex for PhilPapers' role, :1249
+    # RSS feeds for CC-News's role.)
+    "pubmed": (
+        "https://eutils.ncbi.nlm.nih.gov/entrez/eutils/esearch.fcgi"
+        "?db=pubmed&term={term}&retmax={retmax}&retmode=json"
+    ),
+    "openwebtext": (
+        "https://www.reddit.com/r/{subreddit}/top.json"
+        "?limit={limit}&t=week"
+    ),
+    "philpapers": (
+        "https://api.openalex.org/works?filter=concepts.id:{concept}"
+        "&per-page={per_page}"
+    ),
+    "ccnews": "{feed_url}",
 }
 
 
@@ -225,40 +242,103 @@ def network_available(timeout: float = 2.0) -> bool:
         return False
 
 
+def _part_path(dest: str, url: str) -> str:
+    """URL-keyed partial sidecar: a leftover partial can only ever resume
+    the SAME url (different params → different partial), so a Range-
+    honoring server can never splice two downloads into one file."""
+    tag = hashlib.sha1(url.encode()).hexdigest()[:10]
+    return f"{dest}.{tag}.part"
+
+
 def fetch_raw(
     url: str, dest: str, timeout: float = 60.0,
     _opener: Optional[Callable] = None,
+    expected_sha256: Optional[str] = None,
+    resume: bool = True,
 ) -> Optional[str]:
-    """Download url → dest; None (with guidance logged) when unreachable.
+    """Download url → dest with resume + checksum; None when unreachable.
 
-    `_opener` is injectable for tests; defaults to urllib.
+    - Streams to a url-keyed `.part` sidecar and renames on success, so a
+      failed re-fetch can never clobber an earlier good download at dest.
+    - Resume: a leftover partial restarts the transfer with an HTTP Range
+      header from its size; a server that ignores Range (status 200, not
+      206) restarts from byte 0, and 416 (partial already >= remote size,
+      e.g. a republished 'latest' dump that shrank) discards the partial
+      and refetches from scratch. A failed transfer KEEPS the partial for
+      the next attempt (the reference's urlretrieve redownloads dumps
+      from scratch each time, ref multi_source_dataset.py:287).
+    - Integrity: sha256 streams alongside the download (no second disk
+      pass) and is recorded in `<dest>.sha256`; pass expected_sha256 to
+      verify (mismatch deletes the corrupt file and returns None).
+
+    `_opener(url, headers)` is injectable for tests; defaults to urllib.
     """
     opener = _opener or (
-        lambda u: urllib.request.urlopen(u, timeout=timeout)
+        lambda u, h: urllib.request.urlopen(
+            urllib.request.Request(u, headers=h), timeout=timeout
+        )
     )
-    # Stream to a .part sidecar and rename on success, so a failed re-fetch
-    # can never clobber (or delete) an earlier good download at dest.
-    part = dest + ".part"
+    part = _part_path(dest, url)
+    offset = 0
+    if resume:
+        try:
+            offset = os.path.getsize(part)
+        except OSError:
+            offset = 0
+    headers = {"Range": f"bytes={offset}-"} if offset else {}
+    digest = hashlib.sha256()
+    if offset:
+        with open(part, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                digest.update(chunk)
     try:
-        with opener(url) as resp, open(part, "wb") as f:
-            while True:
-                chunk = resp.read(1 << 20)
-                if not chunk:
-                    break
-                f.write(chunk)
-        os.replace(part, dest)
-        return dest
+        with opener(url, headers) as resp:
+            mode = "ab" if offset else "wb"
+            if offset and getattr(resp, "status", 206) == 200:
+                # Server ignored the Range request: full body incoming.
+                mode, offset = "wb", 0
+                digest = hashlib.sha256()
+            with open(part, mode) as f:
+                while True:
+                    chunk = resp.read(1 << 20)
+                    if not chunk:
+                        break
+                    f.write(chunk)
+                    digest.update(chunk)
     except Exception as e:
+        if offset and getattr(e, "code", None) == 416:
+            # Range not satisfiable: the partial is stale (remote shrank
+            # or we died after the last byte). Discard and refetch whole.
+            logger.warning(
+                "range not satisfiable for %s; discarding partial", url
+            )
+            try:
+                os.unlink(part)
+            except OSError:
+                pass
+            return fetch_raw(
+                url, dest, timeout, _opener, expected_sha256, resume=False
+            )
         logger.error("download failed for %s: %s", url, e)
         logger.info(
             "offline? process a local dump instead: "
-            "DatasetDownloader.process_local_dump(path)"
+            "DatasetDownloader.process_local_dump(path) "
+            "(partial kept for resume: %s)", part,
         )
-        try:
-            os.unlink(part)
-        except OSError:
-            pass
         return None
+
+    hexdigest = digest.hexdigest()
+    if expected_sha256 and hexdigest != expected_sha256.lower():
+        logger.error(
+            "checksum mismatch for %s: got %s want %s — discarding",
+            url, hexdigest, expected_sha256,
+        )
+        os.unlink(part)
+        return None
+    os.replace(part, dest)
+    with open(dest + ".sha256", "w") as f:
+        f.write(f"{hexdigest}  {os.path.basename(dest)}\n")
+    return dest
 
 
 class DatasetDownloader:
@@ -341,10 +421,13 @@ class DatasetDownloader:
 
 
 def fetch_source(
-    source: str, output_dir: str, _opener: Optional[Callable] = None, **params
+    source: str, output_dir: str, _opener: Optional[Callable] = None,
+    expected_sha256: Optional[str] = None, resume: bool = True, **params
 ) -> Optional[str]:
     """Fetch one multi-source corpus dump (ref multi_source_dataset.py
-    *Processor.download_* methods). Returns the local path or None offline."""
+    *Processor.download_* methods — all eight sources). Returns the local
+    path or None offline; resumes partials and records sha256 (fetch_raw).
+    """
     if source not in SOURCE_URLS:
         raise ValueError(
             f"unknown source {source!r}; known: {sorted(SOURCE_URLS)}"
@@ -352,9 +435,17 @@ def fetch_source(
     defaults = {
         "lang": "simplewiki", "book_id": "1342", "category": "cs.LG",
         "max_results": 100, "tag": "python", "page_size": 100,
+        "term": "machine+learning", "retmax": 100,
+        "subreddit": "machinelearning", "limit": 100,
+        "concept": "C138885662",  # OpenAlex: philosophy
+        "per_page": 100,
+        "feed_url": "http://feeds.bbci.co.uk/news/rss.xml",
     }
     defaults.update(params)
     url = SOURCE_URLS[source].format(**defaults)
     dest = str(Path(output_dir) / f"{source}_raw.dat")
     Path(output_dir).mkdir(parents=True, exist_ok=True)
-    return fetch_raw(url, dest, _opener=_opener)
+    return fetch_raw(
+        url, dest, _opener=_opener, expected_sha256=expected_sha256,
+        resume=resume,
+    )

@@ -103,8 +103,18 @@ def test_downloader_process_local_dump(tmp_path):
     assert report["valid"] > 0
 
 
+class FakeResp(io.BytesIO):
+    status = 206
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
 def test_fetch_raw_offline_returns_none(tmp_path):
-    def failing_opener(url):
+    def failing_opener(url, headers):
         raise OSError("no route to host")
 
     out = fetch_raw(
@@ -115,14 +125,7 @@ def test_fetch_raw_offline_returns_none(tmp_path):
 
 
 def test_fetch_source_with_injected_opener(tmp_path):
-    class FakeResp(io.BytesIO):
-        def __enter__(self):
-            return self
-
-        def __exit__(self, *a):
-            return False
-
-    def opener(url):
+    def opener(url, headers):
         assert "wikimedia" in url
         return FakeResp(b"dump-bytes")
 
@@ -130,3 +133,137 @@ def test_fetch_source_with_injected_opener(tmp_path):
     assert out and Path(out).read_bytes() == b"dump-bytes"
     with pytest.raises(ValueError):
         fetch_source("unknown_source", str(tmp_path))
+
+
+def test_fetch_source_url_construction_all_sources(tmp_path):
+    """Every reference corpus has a working URL template (ref
+    multi_source_dataset.py download_* across all eight processors)."""
+    from luminaai_tpu.data.acquisition import SOURCE_URLS
+
+    seen = {}
+
+    def opener(url, headers):
+        seen[len(seen)] = url
+        return FakeResp(b"x")
+
+    for source in SOURCE_URLS:
+        out = fetch_source(source, str(tmp_path / source), _opener=opener)
+        assert out is not None, source
+    urls = list(seen.values())
+    assert len(urls) == len(SOURCE_URLS)
+    assert all(u.startswith("http") for u in urls), urls
+    assert not any("{" in u for u in urls), urls  # templates fully filled
+
+
+def test_fetch_raw_writes_checksum_and_verifies(tmp_path):
+    import hashlib
+
+    payload = b"corpus-bytes"
+    good = hashlib.sha256(payload).hexdigest()
+
+    def opener(url, headers):
+        return FakeResp(payload)
+
+    dest = tmp_path / "d.dat"
+    out = fetch_raw("https://e.com/d", str(dest), _opener=opener,
+                    expected_sha256=good)
+    assert out and dest.read_bytes() == payload
+    recorded = (tmp_path / "d.dat.sha256").read_text().split()[0]
+    assert recorded == good
+
+    # Mismatch: corrupt download is discarded, nothing clobbers dest2.
+    from luminaai_tpu.data.acquisition import _part_path
+
+    dest2 = tmp_path / "e.dat"
+    out = fetch_raw("https://e.com/e", str(dest2), _opener=opener,
+                    expected_sha256="0" * 64)
+    assert out is None
+    assert not dest2.exists()
+    assert not Path(_part_path(str(dest2), "https://e.com/e")).exists()
+
+
+def test_fetch_raw_resumes_partial_with_range(tmp_path):
+    """A leftover partial resumes via HTTP Range and appends; a failed
+    transfer keeps the partial for the next try. Partials are url-keyed,
+    so a different url cannot splice onto this one."""
+    from luminaai_tpu.data.acquisition import _part_path
+
+    dest = tmp_path / "r.dat"
+    part = Path(_part_path(str(dest), "https://e.com/r"))
+
+    def dying_opener(url, headers):
+        part_resp = FakeResp(b"first-")
+        orig_read = part_resp.read
+
+        def read(n):
+            chunk = orig_read(n)
+            if not chunk:
+                raise OSError("connection reset")
+            return chunk
+
+        part_resp.read = read
+        return part_resp
+
+    assert fetch_raw("https://e.com/r", str(dest), _opener=dying_opener) is None
+    assert part.read_bytes() == b"first-"  # partial kept
+
+    ranges = []
+
+    def resuming_opener(url, headers):
+        ranges.append(headers.get("Range"))
+        return FakeResp(b"rest")
+
+    # A DIFFERENT url ignores the other url's partial entirely.
+    out2 = fetch_raw("https://e.com/other", str(tmp_path / "o.dat"),
+                     _opener=resuming_opener)
+    assert out2 and ranges == [None]
+
+    ranges.clear()
+    out = fetch_raw("https://e.com/r", str(dest), _opener=resuming_opener)
+    assert out and dest.read_bytes() == b"first-rest"
+    assert ranges == ["bytes=6-"]
+    # Streamed checksum over resumed bytes matches the whole file.
+    import hashlib
+
+    assert (tmp_path / "r.dat.sha256").read_text().split()[0] == (
+        hashlib.sha256(b"first-rest").hexdigest()
+    )
+
+
+def test_fetch_raw_restarts_when_server_ignores_range(tmp_path):
+    from luminaai_tpu.data.acquisition import _part_path
+
+    dest = tmp_path / "s.dat"
+    Path(_part_path(str(dest), "https://e.com/s")).write_bytes(b"stale-half")
+
+    def full_body_opener(url, headers):
+        resp = FakeResp(b"whole-file")
+        resp.status = 200  # not 206: Range ignored
+        return resp
+
+    out = fetch_raw("https://e.com/s", str(dest), _opener=full_body_opener)
+    assert out and dest.read_bytes() == b"whole-file"
+
+
+def test_fetch_raw_416_discards_stale_partial(tmp_path):
+    """Range-not-satisfiable (remote shrank / partial complete) must not
+    wedge: the stale partial is discarded and the fetch restarts whole."""
+    import urllib.error
+
+    from luminaai_tpu.data.acquisition import _part_path
+
+    dest = tmp_path / "w.dat"
+    Path(_part_path(str(dest), "https://e.com/w")).write_bytes(b"toolongpartial")
+    calls = []
+
+    def opener(url, headers):
+        calls.append(headers.get("Range"))
+        if headers.get("Range"):
+            raise urllib.error.HTTPError(
+                url, 416, "Range Not Satisfiable", {}, None
+            )
+        return FakeResp(b"fresh")
+
+    out = fetch_raw("https://e.com/w", str(dest), _opener=opener)
+    assert out and dest.read_bytes() == b"fresh"
+    assert calls == ["bytes=14-", None]
