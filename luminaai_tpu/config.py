@@ -87,6 +87,14 @@ class Config:
     # partition inside the manual-pipe region); requires being inside a
     # shard_map with a manual 'expert' axis.
     moe_manual_ep: bool = False
+    # Internal: call the ring-attention body directly (no nested
+    # shard_map) — set by the 1F1B pipeline builders when sp > 1; requires
+    # a manual 'sequence' axis in scope.
+    ring_manual: bool = False
+    # Internal: manual axes tokens are sharded over inside the pipeline
+    # region; MoE routing stats pmean over these so aux/z losses use
+    # global fractions.
+    moe_stat_pmean_axes: tuple = ()
 
     # --- MoD (mixture of depths) ---
     use_mod: bool = False
@@ -237,6 +245,9 @@ class Config:
     host_offload_optimizer: bool = False  # ref cpu_offload_* analogue
 
     def __post_init__(self):
+        # yaml/json roundtrips turn tuples into lists; normalize back so
+        # to_dict() comparisons and static hashing stay stable.
+        self.moe_stat_pmean_axes = tuple(self.moe_stat_pmean_axes)
         if self.num_kv_heads is None:
             self.num_kv_heads = self.num_heads
         if self.intermediate_size is None:
@@ -371,27 +382,25 @@ class Config:
                 "pipeline_microbatches instead (same memory effect, no "
                 "extra pipeline bubbles)"
             )
-            # pp composes with data/fsdp/tensor/expert (tp inside a stage
-            # is auto-sharded by XLA under the partial-manual shard_map;
-            # ep rides the expert-sharded weights — activation-reshard
-            # constraints are dropped in-region, see models/moe.py
-            # moe_ep_constraints). Ring-attention sequence parallelism
-            # would nest a second manual region inside the pipe schedule;
-            # XLA's SPMD partitioner rejects the collectives it needs
-            # (observed partitioner group-check crash).
-            assert self.sequence_parallel_size == 1, (
-                "pipeline parallelism composes with data/fsdp/tensor/"
-                "expert only; sequence_parallel_size must be 1"
-            )
-            if self.expert_parallel_size > 1:
+            # pp composes with every axis: data/fsdp/tensor are automatic
+            # under the partial-manual shard_map; expert and sequence join
+            # the manual region under the 1F1B schedule (tokens shard over
+            # them, tiled all-to-alls / in-region ring attention — see
+            # parallel/pipeline.py).
+            if (
+                self.expert_parallel_size > 1
+                or self.sequence_parallel_size > 1
+            ):
                 assert self.pipeline_schedule == "1f1b", (
-                    "pp x ep requires pipeline_schedule='1f1b' (manual "
-                    "expert parallelism lives in the 1F1B region)"
+                    "pp x ep / pp x sp require pipeline_schedule='1f1b' "
+                    "(manual expert/sequence parallelism lives in the "
+                    "1F1B region)"
                 )
                 assert not self.use_mod, (
-                    "pp x ep with MoD is unsupported (MoD aux metrics are "
-                    "not expert-shard aware)"
+                    "pp x ep/sp with MoD is unsupported (MoD aux metrics "
+                    "are not token-shard aware)"
                 )
+            if self.expert_parallel_size > 1:
                 assert (
                     self.batch_size // n_micro
                 ) % self.expert_parallel_size == 0, (

@@ -26,6 +26,10 @@ logger = logging.getLogger(__name__)
 
 OASST_DATASET = "OpenAssistant/oasst2"
 
+# Descriptive UA: several corpus hosts (reddit especially) reject
+# urllib's default Python-urllib/3.x agent outright.
+_USER_AGENT = "luminaai-tpu-dataloader/1.0 (research corpus acquisition)"
+
 # Raw-dump URL templates for the multi-source pipeline's corpora (ref
 # multi_source_dataset.py WikipediaProcessor.download_dump etc.).
 SOURCE_URLS: Dict[str, str] = {
@@ -261,31 +265,51 @@ def fetch_raw(
     - Streams to a url-keyed `.part` sidecar and renames on success, so a
       failed re-fetch can never clobber an earlier good download at dest.
     - Resume: a leftover partial restarts the transfer with an HTTP Range
-      header from its size; a server that ignores Range (status 200, not
-      206) restarts from byte 0, and 416 (partial already >= remote size,
-      e.g. a republished 'latest' dump that shrank) discards the partial
-      and refetches from scratch. A failed transfer KEEPS the partial for
-      the next attempt (the reference's urlretrieve redownloads dumps
-      from scratch each time, ref multi_source_dataset.py:287).
+      + If-Range request (validator = the ETag/Last-Modified captured
+      when the partial was started, kept in a `.meta` sidecar). If-Range
+      makes a changed remote serve the WHOLE file (status 200) instead of
+      splicing two versions; a partial with no stored validator is
+      discarded rather than trusted. 416 (partial >= remote size, e.g. a
+      republished 'latest' dump that shrank) also discards and refetches.
+      A failed transfer KEEPS the partial for the next attempt (the
+      reference's urlretrieve redownloads dumps from scratch each time,
+      ref multi_source_dataset.py:287).
     - Integrity: sha256 streams alongside the download (no second disk
       pass) and is recorded in `<dest>.sha256`; pass expected_sha256 to
       verify (mismatch deletes the corrupt file and returns None).
+    - Success removes every other `<dest>.*.part` sibling (stale partials
+      from old parameter sets don't accumulate).
 
     `_opener(url, headers)` is injectable for tests; defaults to urllib.
     """
     opener = _opener or (
         lambda u, h: urllib.request.urlopen(
-            urllib.request.Request(u, headers=h), timeout=timeout
+            urllib.request.Request(
+                u, headers={"User-Agent": _USER_AGENT, **h}
+            ),
+            timeout=timeout,
         )
     )
     part = _part_path(dest, url)
+    meta = part + ".meta"
     offset = 0
+    validator = None
     if resume:
         try:
             offset = os.path.getsize(part)
+            with open(meta) as f:
+                validator = f.read().strip() or None
         except OSError:
+            validator = None
+        if offset and not validator:
+            # No validator captured for this partial: resuming could
+            # silently splice two versions of the remote file. Start over.
+            logger.info("partial without validator; refetching %s whole", url)
             offset = 0
-    headers = {"Range": f"bytes={offset}-"} if offset else {}
+    headers = {}
+    if offset:
+        headers["Range"] = f"bytes={offset}-"
+        headers["If-Range"] = validator
     digest = hashlib.sha256()
     if offset:
         with open(part, "rb") as f:
@@ -295,9 +319,18 @@ def fetch_raw(
         with opener(url, headers) as resp:
             mode = "ab" if offset else "wb"
             if offset and getattr(resp, "status", 206) == 200:
-                # Server ignored the Range request: full body incoming.
+                # Range ignored OR If-Range detected a changed remote:
+                # full body incoming.
                 mode, offset = "wb", 0
                 digest = hashlib.sha256()
+            if mode == "wb":
+                resp_headers = getattr(resp, "headers", None)
+                new_validator = resp_headers and (
+                    resp_headers.get("ETag")
+                    or resp_headers.get("Last-Modified")
+                )
+                with open(meta, "w") as f:
+                    f.write(new_validator or "")
             with open(part, mode) as f:
                 while True:
                     chunk = resp.read(1 << 20)
@@ -312,10 +345,11 @@ def fetch_raw(
             logger.warning(
                 "range not satisfiable for %s; discarding partial", url
             )
-            try:
-                os.unlink(part)
-            except OSError:
-                pass
+            for path in (part, meta):
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
             return fetch_raw(
                 url, dest, timeout, _opener, expected_sha256, resume=False
             )
@@ -333,9 +367,24 @@ def fetch_raw(
             "checksum mismatch for %s: got %s want %s — discarding",
             url, hexdigest, expected_sha256,
         )
-        os.unlink(part)
+        for path in (part, meta):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
         return None
     os.replace(part, dest)
+    # GC: this url's meta plus any stale partials from other urls that
+    # mapped to the same dest (old parameter sets never resumed again).
+    import glob as _glob
+
+    for stale in _glob.glob(f"{dest}.*.part") + _glob.glob(
+        f"{dest}.*.part.meta"
+    ):
+        try:
+            os.unlink(stale)
+        except OSError:
+            pass
     with open(dest + ".sha256", "w") as f:
         f.write(f"{hexdigest}  {os.path.basename(dest)}\n")
     return dest

@@ -235,6 +235,40 @@ class GQAttention(nn.Module):
             q, ("activation_batch", "activation_length", "activation_heads", None)
         )
 
+        # Manual ring attention: already inside a shard_map whose manual
+        # axes include 'sequence' (the 1F1B pipeline region) — q/k/v are
+        # per-shard chunks, so call the ring BODY directly; nesting the
+        # ring's own shard_map would be rejected.
+        if (
+            cfg.ring_manual
+            and cfg.sequence_parallel_size > 1
+            and kv_cache is None
+            and not self.is_initializing()
+        ):
+            from luminaai_tpu.ops.flash_attention import flash_eligible
+            from luminaai_tpu.ops.ring_attention import (
+                _ring_attention_shard,
+                _ring_attention_shard_flash,
+            )
+
+            sp = cfg.sequence_parallel_size
+            if cfg.use_flash_attention and flash_eligible(
+                S, d, cfg.flash_block_q, cfg.flash_block_kv
+            ):
+                out = _ring_attention_shard_flash(
+                    q, k, v, axis_name="sequence", axis_size=sp,
+                    causal=True,
+                    block_q=min(cfg.flash_block_q, S),
+                    block_kv=min(cfg.flash_block_kv, S),
+                )
+            else:
+                out = _ring_attention_shard(
+                    q, k, v, axis_name="sequence", axis_size=sp,
+                    causal=True,
+                )
+            y = jnp.einsum("bshk,hkd->bsd", out, wo.astype(self.dtype))
+            return y, new_cache
+
         # Ring attention: sequence/context parallelism. Activations arrive
         # sequence-sharded (activation_length → 'sequence'); K/V chunks
         # rotate the ring via ppermute instead of XLA all-gathering the full

@@ -338,16 +338,18 @@ class MoELayer(nn.Module):
         p = router_probs.mean(axis=(0, 1))
         lse2 = jnp.mean(jax.nn.logsumexp(gate_logits, axis=-1) ** 2)
         drop = dropped.mean()
-        if manual_ep:
-            # Token shards each saw 1/ep of the batch: average the routing
-            # stats over the expert axis so the aux/z losses are computed
-            # from GLOBAL fractions (sum-of-products ≠ product-of-sums —
-            # matching the non-manual math exactly, grads included via the
-            # differentiable pmean).
-            f = jax.lax.pmean(f, "expert")
-            p = jax.lax.pmean(p, "expert")
-            lse2 = jax.lax.pmean(lse2, "expert")
-            drop = jax.lax.pmean(drop, "expert")
+        if cfg.moe_stat_pmean_axes:
+            # Token shards each saw a fraction of the batch (over 'expert'
+            # when ep borrows the data dim, over 'sequence' under manual
+            # sp): average the routing stats over those axes so the aux/z
+            # losses are computed from GLOBAL fractions (sum-of-products ≠
+            # product-of-sums — matching the non-manual math, grads
+            # included via the differentiable pmean).
+            axes = tuple(cfg.moe_stat_pmean_axes)
+            f = jax.lax.pmean(f, axes)
+            p = jax.lax.pmean(p, axes)
+            lse2 = jax.lax.pmean(lse2, axes)
+            drop = jax.lax.pmean(drop, axes)
         aux_loss = jnp.clip(
             jnp.sum(f * p) * E * cfg.load_balancing_weight, max=1.0
         )

@@ -63,6 +63,34 @@ def pipeline_compatible(config: Config) -> Tuple[bool, str]:
     return True, ""
 
 
+def _pipe_manual_axes(config: Config):
+    """(manual_axes, token_axes) for the 1F1B region. token_axes are the
+    manual axes tokens are sharded over (grad partials psum over them)."""
+    manual = ["pipe"]
+    token = []
+    if config.expert_parallel_size > 1:
+        manual.append("expert")
+        token.append("expert")
+    if config.sequence_parallel_size > 1:
+        manual.append("sequence")
+        token.append("sequence")
+    return tuple(manual), tuple(token)
+
+
+def _pipe_block_config(config: Config) -> Config:
+    """Block config for tracing inside the manual region: auto expert
+    constraints off, manual all-to-all MoE when ep>1, in-region ring
+    attention when sp>1, routing stats pmean'd over the token axes."""
+    _, token_axes = _pipe_manual_axes(config)
+    return dataclasses.replace(
+        config,
+        moe_ep_constraints=False,
+        moe_manual_ep=config.expert_parallel_size > 1,
+        ring_manual=config.sequence_parallel_size > 1,
+        moe_stat_pmean_axes=token_axes,
+    )
+
+
 def _is_expert_leaf(path) -> bool:
     """Stack-param leaves whose dim 1 (after the layer axis) is the expert
     dim — the MoE module's wi/wo. Everything else (attention — which has
@@ -80,10 +108,13 @@ def _stage_apply(
     rng: jax.Array,
     n_local: int,
     first_global_layer: jax.Array,
+    positions: Optional[jax.Array] = None,
 ):
     """Run this stage's n_local layers over x via lax.scan.
 
     stack_local: param tree with leading axis n_local (this stage's slice).
+    positions: explicit RoPE positions (manual sequence parallelism passes
+    this stage's global offsets; None = arange over local length).
     Returns (x, metrics_summed_over_local_layers).
     """
 
@@ -93,6 +124,7 @@ def _stage_apply(
         out, _, metrics = block.apply(
             {"params": layer_params},
             carry,
+            positions=positions,
             rngs={"routing": layer_rng, "dropout": jax.random.fold_in(layer_rng, 1)},
         )
         return out, metrics
@@ -298,16 +330,16 @@ def make_1f1b_loss_fn(config: Config, model, mesh: Mesh) -> Callable:
     T = n_micro + 2 * Pn - 1
     zw = config.z_loss_weight
     dtype = model.dtype
-    # Expert parallelism composes MANUALLY here: the 'expert' axis joins
-    # the manual region, microbatch tokens are sharded over it (ep borrows
-    # the data dimension), and MoELayer runs tiled all-to-alls around its
-    # local experts (models/moe.py moe_manual_ep).
+    # Expert and sequence parallelism compose MANUALLY here: those axes
+    # join the manual region; microbatch tokens shard over 'expert' (ep
+    # borrows the data dimension) and the sequence dim shards over
+    # 'sequence' (ring attention body runs in-region, RoPE positions get
+    # per-shard global offsets).
     ep = config.expert_parallel_size
-    manual_axes = ("pipe", "expert") if ep > 1 else ("pipe",)
+    sp = config.sequence_parallel_size
+    manual_axes, token_axes = _pipe_manual_axes(config)
     block = TransformerBlock(
-        dataclasses.replace(
-            config, moe_ep_constraints=False, moe_manual_ep=ep > 1
-        ),
+        _pipe_block_config(config),
         layer_idx=0, dtype=dtype, deterministic=False,
     )
 
@@ -327,6 +359,12 @@ def make_1f1b_loss_fn(config: Config, model, mesh: Mesh) -> Callable:
         H = config.hidden_size
         fwd_perm = [(i, (i + 1) % Pn) for i in range(Pn)]
         bwd_perm = [(i, (i - 1) % Pn) for i in range(Pn)]
+        # Manual sp: S here is the LOCAL chunk; RoPE needs global offsets.
+        positions = None
+        if sp > 1:
+            positions = (
+                jax.lax.axis_index("sequence") * S + jnp.arange(S)
+            )[None, :]
 
         def full_fn(stack, io_, x_recv, ids, lab, wts, m_idx):
             """Embed (stage 0) → stage layers → final norm + CE sums (last
@@ -338,6 +376,7 @@ def make_1f1b_loss_fn(config: Config, model, mesh: Mesh) -> Callable:
             h, metrics = _stage_apply(
                 config, block, stack, x_in,
                 jax.random.fold_in(rng, m_idx), n_local, first_layer,
+                positions=positions,
             )
             nh = final_norm.apply({"params": io_["final_norm"]}, h)
             emb_head = io_["embedder"][head_name]
@@ -366,6 +405,7 @@ def make_1f1b_loss_fn(config: Config, model, mesh: Mesh) -> Callable:
             h, _ = _stage_apply(
                 config, block, stack, x_in,
                 jax.random.fold_in(rng, m_idx), n_local, first_layer,
+                positions=positions,
             )
             return h
 
@@ -488,14 +528,24 @@ def make_1f1b_loss_fn(config: Config, model, mesh: Mesh) -> Callable:
         )
         macc = jax.tree.map(lambda v: jax.lax.psum(v, "pipe"), carry["macc"])
         g_stack = carry["g_stack"]
-        if ep > 1:
+        if token_axes:
             macc = jax.tree.map(
-                lambda v: jax.lax.pmean(v, "expert"), macc
+                lambda v: jax.lax.pmean(v, token_axes), macc
             )
+            # wi/wo grads are already total over the expert axis (post
+            # all-to-all, experts see every expert-shard's tokens) but
+            # still partial over sequence chunks; everything else is
+            # partial over every token axis.
+            expert_grad_axes = tuple(a for a in token_axes if a != "expert")
             g_stack = jax.tree_util.tree_map_with_path(
                 lambda pth, g: (
-                    g if _is_expert_leaf(pth)
-                    else jax.lax.psum(g, "expert")
+                    (
+                        jax.lax.psum(g, expert_grad_axes)
+                        if expert_grad_axes
+                        else g
+                    )
+                    if _is_expert_leaf(pth)
+                    else jax.lax.psum(g, token_axes)
                 ),
                 g_stack,
             )
@@ -541,8 +591,13 @@ def make_1f1b_loss_fn(config: Config, model, mesh: Mesh) -> Callable:
             ),
             stack,
         )
-        # Tokens shard over 'expert' on the microbatch dim when ep > 1.
-        mb_spec = P(None, "expert") if ep > 1 else P()
+        # Tokens shard over 'expert' on the microbatch dim (ep > 1) and
+        # over 'sequence' on the length dim (sp > 1).
+        mb_spec = P(
+            None,
+            "expert" if ep > 1 else None,
+            "sequence" if sp > 1 else None,
+        )
         sharded = jax.shard_map(
             schedule_body,
             mesh=mesh,
@@ -625,11 +680,10 @@ def make_pipeline_fwd_metrics_fn(config: Config, model, mesh: Mesh) -> Callable:
     zw = config.z_loss_weight
     dtype = model.dtype
     ep = config.expert_parallel_size
-    manual_axes = ("pipe", "expert") if ep > 1 else ("pipe",)
+    sp = config.sequence_parallel_size
+    manual_axes, token_axes = _pipe_manual_axes(config)
     block = TransformerBlock(
-        dataclasses.replace(
-            config, moe_ep_constraints=False, moe_manual_ep=ep > 1
-        ),
+        _pipe_block_config(config),
         layer_idx=0, dtype=dtype, deterministic=True,
     )
 
@@ -646,6 +700,11 @@ def make_pipeline_fwd_metrics_fn(config: Config, model, mesh: Mesh) -> Callable:
         mb, S = ids_mb.shape[1], ids_mb.shape[2]
         H = config.hidden_size
         fwd_perm = [(i, (i + 1) % Pn) for i in range(Pn)]
+        positions = None
+        if sp > 1:
+            positions = (
+                jax.lax.axis_index("sequence") * S + jnp.arange(S)
+            )[None, :]
 
         def fwd_ce(x_recv, ids, lab, wts, m_idx):
             emb_x = embedder.apply(
@@ -655,6 +714,7 @@ def make_pipeline_fwd_metrics_fn(config: Config, model, mesh: Mesh) -> Callable:
             h, metrics = _stage_apply(
                 config, block, stack_local, x_in,
                 jax.random.fold_in(rng, m_idx), n_local, first_layer,
+                positions=positions,
             )
             nh = final_norm.apply({"params": io["final_norm"]}, h)
             emb_head = io["embedder"][head_name]
@@ -718,8 +778,10 @@ def make_pipeline_fwd_metrics_fn(config: Config, model, mesh: Mesh) -> Callable:
             lambda v: jax.lax.psum(v, manual_axes), carry["ce"]
         )
         macc = jax.tree.map(lambda v: jax.lax.psum(v, "pipe"), carry["macc"])
-        if ep > 1:
-            macc = jax.tree.map(lambda v: jax.lax.pmean(v, "expert"), macc)
+        if token_axes:
+            macc = jax.tree.map(
+                lambda v: jax.lax.pmean(v, token_axes), macc
+            )
         return ce, macc
 
     def eval_loss(params, batch: Batch):
@@ -751,7 +813,11 @@ def make_pipeline_fwd_metrics_fn(config: Config, model, mesh: Mesh) -> Callable:
             ),
             stack,
         )
-        mb_spec = P(None, "expert") if ep > 1 else P()
+        mb_spec = P(
+            None,
+            "expert" if ep > 1 else None,
+            "sequence" if sp > 1 else None,
+        )
         sharded = jax.shard_map(
             schedule_body,
             mesh=mesh,

@@ -74,6 +74,21 @@ def logical_axis_rules(config: Optional[Config] = None):
                 (l, None if l in ("kv_heads", "activation_kv_heads") else m)
                 for l, m in rules
             ]
+    if (
+        config is not None
+        and config.pipeline_parallel_size > 1
+        and config.sequence_parallel_size > 1
+    ):
+        # Inside the 1F1B manual region the 'sequence' axis is manual:
+        # activations arrive pre-chunked and the ring body does its own
+        # ppermutes, so an auto activation_length constraint would ask the
+        # SPMD partitioner to reshard over a manual axis (the group-check
+        # crash class). Every block constraint traces inside the region
+        # under pp, so dropping the rule for the whole pipeline step is
+        # sound.
+        rules = [
+            (l, None if l == "activation_length" else m) for l, m in rules
+        ]
     return tuple(rules)
 
 

@@ -105,6 +105,7 @@ def test_downloader_process_local_dump(tmp_path):
 
 class FakeResp(io.BytesIO):
     status = 206
+    headers = {"ETag": 'W/"v1"'}
 
     def __enter__(self):
         return self
@@ -210,18 +211,20 @@ def test_fetch_raw_resumes_partial_with_range(tmp_path):
     ranges = []
 
     def resuming_opener(url, headers):
-        ranges.append(headers.get("Range"))
+        ranges.append((headers.get("Range"), headers.get("If-Range")))
         return FakeResp(b"rest")
 
     # A DIFFERENT url ignores the other url's partial entirely.
     out2 = fetch_raw("https://e.com/other", str(tmp_path / "o.dat"),
                      _opener=resuming_opener)
-    assert out2 and ranges == [None]
+    assert out2 and ranges == [(None, None)]
 
     ranges.clear()
     out = fetch_raw("https://e.com/r", str(dest), _opener=resuming_opener)
     assert out and dest.read_bytes() == b"first-rest"
-    assert ranges == ["bytes=6-"]
+    # Resume is validator-guarded: If-Range carries the ETag captured
+    # when the partial started, so a changed remote serves whole.
+    assert ranges == [("bytes=6-", 'W/"v1"')]
     # Streamed checksum over resumed bytes matches the whole file.
     import hashlib
 
@@ -234,15 +237,35 @@ def test_fetch_raw_restarts_when_server_ignores_range(tmp_path):
     from luminaai_tpu.data.acquisition import _part_path
 
     dest = tmp_path / "s.dat"
-    Path(_part_path(str(dest), "https://e.com/s")).write_bytes(b"stale-half")
+    part = _part_path(str(dest), "https://e.com/s")
+    Path(part).write_bytes(b"stale-half")
+    Path(part + ".meta").write_text('W/"v1"')
 
     def full_body_opener(url, headers):
         resp = FakeResp(b"whole-file")
-        resp.status = 200  # not 206: Range ignored
+        resp.status = 200  # Range ignored / If-Range says remote changed
         return resp
 
     out = fetch_raw("https://e.com/s", str(dest), _opener=full_body_opener)
     assert out and dest.read_bytes() == b"whole-file"
+
+
+def test_fetch_raw_discards_partial_without_validator(tmp_path):
+    """A partial whose origin validator was never captured cannot be
+    safely resumed (silent version splice) — refetch whole."""
+    from luminaai_tpu.data.acquisition import _part_path
+
+    dest = tmp_path / "n.dat"
+    Path(_part_path(str(dest), "https://e.com/n")).write_bytes(b"orphan")
+    sent = []
+
+    def opener(url, headers):
+        sent.append(headers.get("Range"))
+        return FakeResp(b"complete")
+
+    out = fetch_raw("https://e.com/n", str(dest), _opener=opener)
+    assert out and dest.read_bytes() == b"complete"
+    assert sent == [None]  # no Range without a validator
 
 
 def test_fetch_raw_416_discards_stale_partial(tmp_path):
@@ -253,7 +276,9 @@ def test_fetch_raw_416_discards_stale_partial(tmp_path):
     from luminaai_tpu.data.acquisition import _part_path
 
     dest = tmp_path / "w.dat"
-    Path(_part_path(str(dest), "https://e.com/w")).write_bytes(b"toolongpartial")
+    part = _part_path(str(dest), "https://e.com/w")
+    Path(part).write_bytes(b"toolongpartial")
+    Path(part + ".meta").write_text('W/"v1"')
     calls = []
 
     def opener(url, headers):
