@@ -244,6 +244,60 @@ class TestPipelineEquivalence:
         assert abs(losses1[0] - losses4[0]) < 5e-2, (losses1, losses4)
 
 
+def test_1f1b_uses_far_less_scratch_memory_than_gpipe():
+    """The 1F1B scheduler's reason to exist: XLA's own memory analysis of
+    the compiled loss+grad must show a fraction of GPipe's temp
+    allocation at high microbatch counts (measured ~13x at n_micro=8,
+    P=2: autodiff-through-the-schedule keeps every tick's carries)."""
+    import flax.linen as nn
+
+    from luminaai_tpu.models.transformer import LuminaTransformer
+    from luminaai_tpu.parallel.mesh import build_mesh, use_mesh
+    from luminaai_tpu.parallel.pipeline import (
+        make_1f1b_loss_fn,
+        make_pipeline_loss_fn,
+    )
+    from luminaai_tpu.parallel.sharding import logical_axis_rules
+
+    temps = {}
+    for schedule in ("gpipe", "1f1b"):
+        cfg = pp_config(
+            pipeline_parallel_size=2, pipeline_microbatches=8,
+            num_layers=4, pipeline_schedule=schedule,
+            seq_length=128, batch_size=16,
+        )
+        model = LuminaTransformer(cfg)
+        sched = make_schedule(cfg, 10)
+        tx = make_optimizer(cfg, 10, sched)
+        mesh = build_mesh(cfg)
+        state, _ = init_sharded_state(cfg, model, tx, mesh, jax.random.key(0))
+        lf = (
+            make_1f1b_loss_fn(cfg, model, mesh)
+            if schedule == "1f1b"
+            else make_pipeline_loss_fn(cfg, model, mesh)
+        )
+
+        def vag(params, batch, rng, lf=lf, cfg=cfg, mesh=mesh):
+            with use_mesh(mesh), nn.logical_axis_rules(
+                logical_axis_rules(cfg)
+            ):
+                (l, m), g = jax.value_and_grad(lf, has_aux=True)(
+                    params, batch, rng
+                )
+                return l, g
+
+        ids = jnp.asarray(
+            np.random.RandomState(0).randint(1, 255, (16, 128)), jnp.int32
+        )
+        compiled = (
+            jax.jit(vag)
+            .lower(state.params, {"input_ids": ids}, jax.random.key(1))
+            .compile()
+        )
+        temps[schedule] = compiled.memory_analysis().temp_size_in_bytes
+    assert temps["1f1b"] * 2 < temps["gpipe"], temps
+
+
 def test_trainer_lifecycle_under_pp(tmp_path):
     """Full Trainer loop with pipeline parallelism: train steps, the
     (non-pipelined) eval step, checkpoint save, and bit-exact resume must
