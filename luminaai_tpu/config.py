@@ -396,10 +396,9 @@ class Config:
                     "(manual expert/sequence parallelism lives in the "
                     "1F1B region)"
                 )
-                assert not self.use_mod, (
-                    "pp x ep/sp with MoD is unsupported (MoD aux metrics "
-                    "are not token-shard aware)"
-                )
+                # MoD composes too: its BCE aux pmean's over the token
+                # axes (models/mod.py apply_mod stat_pmean_axes); routing
+                # is per local chunk with total capacity conserved.
             if self.expert_parallel_size > 1:
                 assert (
                     self.batch_size // n_micro

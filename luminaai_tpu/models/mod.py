@@ -73,13 +73,19 @@ def apply_mod(
     router: MoDRouter,
     inner: Callable[[jax.Array], jax.Array],
     x: jax.Array,
+    stat_pmean_axes: Tuple[str, ...] = (),
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Run `inner` only on router-selected tokens; residual passthrough else.
 
-    x: [G, S, H]. inner: [G, C, H] -> [G, C, H].
+    x: [G, S, H]. inner: [G, C, H] -> [G, C, H]. stat_pmean_axes: manual
+    mesh axes tokens are sharded over (the 1F1B pipeline region) — the BCE
+    aux averages over them so its value and gradient match the global-mean
+    objective; routing itself is per local chunk (capacity conserved).
     """
     G, S, H = x.shape
     indices, gate, aux = router(x)
+    if stat_pmean_axes:
+        aux = jax.lax.pmean(aux, tuple(stat_pmean_axes))
     selected = jnp.take_along_axis(x, indices[..., None], axis=1)  # [G, C, H]
     out_sel = inner(selected) * gate[..., None]
     # Scatter-add processed deltas back to their sequence positions.

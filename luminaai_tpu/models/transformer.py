@@ -100,7 +100,10 @@ class TransformerBlock(nn.Module):
                 dtype=self.dtype,
                 name="mod_router",
             )
-            ffn_out, mod_metrics = apply_mod(router, ffn, y)
+            ffn_out, mod_metrics = apply_mod(
+                router, ffn, y,
+                stat_pmean_axes=cfg.moe_stat_pmean_axes,
+            )
             metrics.update(mod_metrics)
         else:
             ffn_out = SwiGLU(

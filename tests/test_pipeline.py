@@ -225,6 +225,30 @@ class TestPipelineEquivalence:
         )
         assert "PP_EP_SP_MATCH" in out
 
+    def test_pp2_sp2_with_mod_matches(self):
+        """MoD composes with the manual region: per-chunk top-k (capacity
+        conserved) and a pmean'd BCE aux. The sp comparison is loose BY
+        DESIGN — chunk-local top-k selects different tokens than the
+        global top-k; the ep comparison is tight (tokens shard over the
+        batch dim, per-sequence routing unchanged)."""
+        out = self._run_in_subprocess(
+            "kw = dict(use_mod=True, moe_pattern='none')\n"
+            "l1, m1 = run_steps(pp_config(**kw))\n"
+            "l2, m2 = run_steps(pp_config(pipeline_parallel_size=2, "
+            "sequence_parallel_size=2, use_ring_attention=True, **kw))\n"
+            "import numpy as np\n"
+            "assert abs(l1[0] - l2[0]) < 5e-2, (l1, l2)\n"
+            "d = abs(float(m1['mod_aux_loss']) - float(m2['mod_aux_loss']))\n"
+            "assert d < 0.05, d\n"
+            "l3, m3 = run_steps(pp_config(pipeline_parallel_size=2, "
+            "expert_parallel_size=2, **kw))\n"
+            "assert abs(l1[0] - l3[0]) < 1e-3, (l1, l3)\n"
+            "d3 = abs(float(m1['mod_aux_loss']) - float(m3['mod_aux_loss']))\n"
+            "assert d3 < 1e-3, d3\n"
+            "print('PP_SP_MOD_MATCH', l1[0], l2[0], l3[0])\n"
+        )
+        assert "PP_SP_MOD_MATCH" in out
+
     def test_pp_ep_requires_1f1b(self):
         with pytest.raises(AssertionError, match="1f1b"):
             pp_config(
