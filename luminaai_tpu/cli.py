@@ -871,7 +871,11 @@ def build_parser() -> argparse.ArgumentParser:
         )
         par = sp.add_argument_group("parallelism (docs/parallelism.md)")
         par.add_argument("--dp", type=int, help="data axis (-1 = auto)")
-        par.add_argument("--pp", type=int, help="pipeline stages (GPipe)")
+        par.add_argument(
+            "--pp", type=int,
+            help="pipeline stages (1F1B schedule; pipeline_schedule=gpipe "
+                 "via --config for A/B)",
+        )
         par.add_argument("--fsdp", type=int, help="ZeRO-3-style shard ways")
         par.add_argument("--tp", type=int, help="tensor-parallel ways")
         par.add_argument("--ep", type=int, help="expert-parallel ways")

@@ -15,6 +15,8 @@ while true; do
     timeout 2400 python bench_ops.py >> scripts/sweep_out.txt 2>&1
     echo "$(date -u +%FT%TZ) serve_bench" >> scripts/sweep_out.txt
     timeout 1800 python scripts/serve_bench.py 2 4 8 >> scripts/sweep_out.txt 2>&1
+    echo "$(date -u +%FT%TZ) bench.py (early TPU artifact in case the tunnel dies again)" >> scripts/sweep_out.txt
+    timeout 3600 python bench.py >> scripts/sweep_out.txt 2>&1
     echo "$(date -u +%FT%TZ) all done" >> scripts/sweep_out.txt
     exit 0
   fi
