@@ -411,3 +411,39 @@ def test_pipelined_eval_matches_nonpipelined():
     l1 = eval_for(1)
     l2 = eval_for(2)
     assert abs(l1 - l2) < 5e-2, (l1, l2)
+
+
+def test_pipelined_eval_under_ep_and_sp():
+    """The fwd-only pipelined eval must track pp1 eval under the manual
+    ep/sp compositions too (runs in a subprocess: manual-collective
+    programs on the CPU runtime can abort order-dependently)."""
+    out = TestPipelineEquivalence._run_in_subprocess(
+        "import numpy as np, jax, jax.numpy as jnp\n"
+        "from luminaai_tpu.models.transformer import LuminaTransformer\n"
+        "from luminaai_tpu.parallel.mesh import build_mesh\n"
+        "from luminaai_tpu.parallel.sharding import init_sharded_state\n"
+        "from luminaai_tpu.parallel.train_step import make_eval_step\n"
+        "from luminaai_tpu.training.optimizer import make_optimizer, "
+        "make_schedule\n"
+        "ids = np.random.RandomState(0).randint(1, 256, (8, 64))\n"
+        "def eval_for(**kw):\n"
+        "    cfg = pp_config(use_moe=True, num_experts=4, "
+        "moe_pattern='all', **kw)\n"
+        "    model = LuminaTransformer(cfg)\n"
+        "    sched = make_schedule(cfg, 10)\n"
+        "    tx = make_optimizer(cfg, 10, sched)\n"
+        "    mesh = build_mesh(cfg)\n"
+        "    state, sh = init_sharded_state(cfg, model, tx, mesh, "
+        "jax.random.key(0))\n"
+        "    step = make_eval_step(cfg, model, sh, mesh)\n"
+        "    m = step(state, {'input_ids': jnp.asarray(ids, jnp.int32)})\n"
+        "    return float(m['ce_loss'])\n"
+        "l1 = eval_for()\n"
+        "l2 = eval_for(pipeline_parallel_size=2, expert_parallel_size=2)\n"
+        "l3 = eval_for(pipeline_parallel_size=2, sequence_parallel_size=2, "
+        "use_ring_attention=True)\n"
+        "assert abs(l1 - l2) < 5e-2, (l1, l2)\n"
+        "assert abs(l1 - l3) < 5e-2, (l1, l3)\n"
+        "print('PP_EVAL_EP_SP_OK', l1, l2, l3)\n"
+    )
+    assert "PP_EVAL_EP_SP_OK" in out
