@@ -7,7 +7,10 @@ x = jnp.ones((1024, 1024), jnp.bfloat16)
 float((x @ x).sum())
 print("PROBE_OK", jax.devices()[0].platform)'
 while true; do
-  if timeout 90 python -c "$PROBE" 2>/dev/null | grep -q "PROBE_OK tpu"; then
+  # -k 10: a tunnel-wedged probe can ignore TERM while holding the output
+  # pipe open, deadlocking the whole loop — KILL it after a grace period.
+  out=$(timeout -k 10 90 python -c "$PROBE" 2>/dev/null)
+  if echo "$out" | grep -q "PROBE_OK tpu"; then
     echo "$(date -u +%FT%TZ) tunnel up, starting sweep" >> scripts/sweep_out.txt
     timeout 4500 python scripts/perf_sweep.py base saveouts_gather gatherd saveouts chunk1024 b24_saveouts_gather mu16 q8 b24_q8_saveouts_gather scan >> scripts/sweep_out.txt 2>&1
     echo "$(date -u +%FT%TZ) sweep done rc=$?" >> scripts/sweep_out.txt
