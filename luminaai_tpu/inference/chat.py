@@ -97,6 +97,12 @@ def load_model_for_inference(
     from luminaai_tpu.models.transformer import LuminaTransformer
 
     ckpt = Path(checkpoint_dir).absolute()
+    # Accept a training OUTPUT dir directly (what `train --output-dir`
+    # prints): the manager lives in its checkpoints/ subdir.
+    if not any(
+        p.is_dir() and p.name.isdigit() for p in ckpt.glob("*")
+    ) and (ckpt / "checkpoints").is_dir():
+        ckpt = ckpt / "checkpoints"
     with ocp.CheckpointManager(ckpt) as mngr:
         if step is None:
             step = mngr.latest_step()

@@ -176,6 +176,16 @@ def test_chat_from_checkpoint(tmp_path):
 
     model, params, loaded_cfg = load_model_for_inference(str(tmp_path / "ckpt"))
     assert loaded_cfg.hidden_size == 64
+    # A training OUTPUT dir (what `train --output-dir` prints) must work
+    # too — the manager lives in its checkpoints/ subdir. Simulate the
+    # CLI layout: output_dir containing a checkpoints/ directory.
+    import shutil
+
+    out_dir = tmp_path / "as_output_dir"
+    out_dir.mkdir()
+    shutil.copytree(tmp_path / "ckpt", out_dir / "checkpoints")
+    _, _, cfg_from_out = load_model_for_inference(str(out_dir))
+    assert cfg_from_out.hidden_size == 64
     engine = GenerationEngine(model, params, tok, loaded_cfg)
     chat = ChatInterface(engine=engine)
     out = chat.handle_command("/config")
