@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Capture a jax.profiler trace + compiled cost analysis of the flagship
+train step (VERDICT r2: publish where the non-MXU time goes).
+
+Usage: python scripts/profile_flagship.py [variant] [outdir]
+  variant: perf_sweep variant name (default b24_saveouts_gather)
+  outdir:  trace output dir (default run artifacts under profiles/)
+
+Prints the executable's flop/byte estimates and step timing; the
+TensorBoard trace under <outdir> holds the op-level timeline.
+"""
+import dataclasses
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> None:
+    variant = sys.argv[1] if len(sys.argv) > 1 else "b24_saveouts_gather"
+    outdir = sys.argv[2] if len(sys.argv) > 2 else "profiles/flagship"
+
+    import jax
+    import jax.numpy as jnp
+
+    from bench import _child_config
+    from scripts.perf_sweep import VARIANTS
+    from luminaai_tpu.models.transformer import LuminaTransformer
+    from luminaai_tpu.parallel.mesh import build_mesh
+    from luminaai_tpu.parallel.sharding import init_sharded_state
+    from luminaai_tpu.parallel.train_step import make_train_step
+    from luminaai_tpu.training.optimizer import make_optimizer, make_schedule
+
+    cfg = dataclasses.replace(
+        _child_config("flagship", 1), **VARIANTS.get(variant, {})
+    )
+    model = LuminaTransformer(cfg)
+    schedule = make_schedule(cfg, 1000)
+    tx = make_optimizer(cfg, 1000, schedule)
+    mesh = build_mesh(cfg)
+    state, shardings = init_sharded_state(cfg, model, tx, mesh, jax.random.key(0))
+    step = make_train_step(cfg, model, shardings, mesh, schedule, tx)
+
+    ids = np.random.RandomState(0).randint(
+        1, cfg.vocab_size, size=(cfg.batch_size, cfg.seq_length)
+    )
+    batch = {"input_ids": jnp.asarray(ids, jnp.int32)}
+
+    t0 = time.perf_counter()
+    state, m = step(state, batch)
+    print(f"compile+first step: {time.perf_counter() - t0:.1f}s "
+          f"loss={float(m['loss']):.4f}")
+    state, m = step(state, batch)
+    float(m["loss"])  # settle
+
+    # Timed window without tracing (baseline step time).
+    n = 5
+    t0 = time.perf_counter()
+    for _ in range(n):
+        state, m = step(state, batch)
+    float(m["loss"])
+    base_ms = (time.perf_counter() - t0) / n * 1e3
+    tokens = cfg.batch_size * cfg.seq_length
+    print(f"variant={variant} step={base_ms:.0f}ms "
+          f"tok/s/chip={tokens / base_ms * 1e3:.0f}")
+
+    os.makedirs(outdir, exist_ok=True)
+    with jax.profiler.trace(outdir):
+        for _ in range(3):
+            state, m = step(state, batch)
+        float(m["loss"])
+    print(f"trace written under {outdir} (3 steps; open with tensorboard "
+          f"or xprof)")
+
+
+if __name__ == "__main__":
+    main()
