@@ -472,8 +472,15 @@ class Config:
         params = self.estimate_parameters()
         bytes_per = 2 if "bf16" in self.resolve_precision() else 4
         param_gb = params * bytes_per / 1e9
-        # Adam: 2 fp32 moments + fp32 master copy when training in bf16
-        opt_gb = params * 12 / 1e9
+        # Adam: fp32 master copy + 2 moments whose width the config picks
+        # (fp32 default; bf16 mu; int8 codes + row scales ≈ 1B each).
+        if self.adam_state_quantization == "int8":
+            moment_bytes = 2  # mu + nu codes; scales are ~1/last_dim extra
+        elif self.adam_mu_dtype == "bf16":
+            moment_bytes = 6  # bf16 mu + fp32 nu
+        else:
+            moment_bytes = 8
+        opt_gb = params * (4 + moment_bytes) / 1e9
         act_gb = (
             self.micro_batch_size
             * self.seq_length
