@@ -12,7 +12,8 @@ while true; do
   out=$(timeout -k 10 90 python -c "$PROBE" 2>/dev/null)
   if echo "$out" | grep -q "PROBE_OK tpu"; then
     echo "$(date -u +%FT%TZ) tunnel up, starting sweep" >> scripts/sweep_out.txt
-    timeout 4500 python scripts/perf_sweep.py base saveouts_gather gatherd saveouts chunk1024 b24_saveouts_gather mu16 q8 b24_q8_saveouts_gather scan >> scripts/sweep_out.txt 2>&1
+    # Likely winners first so a late recovery still yields an A/B.
+    timeout 4500 python scripts/perf_sweep.py base saveouts_gather b24_saveouts_gather b24_q8_saveouts_gather q8 gatherd saveouts chunk1024 mu16 scan >> scripts/sweep_out.txt 2>&1
     echo "$(date -u +%FT%TZ) sweep done rc=$?" >> scripts/sweep_out.txt
     echo "$(date -u +%FT%TZ) bench_ops" >> scripts/sweep_out.txt
     timeout 2400 python bench_ops.py >> scripts/sweep_out.txt 2>&1
