@@ -26,7 +26,7 @@ WORKER = textwrap.dedent("""
     import jax
     jax.config.update("jax_platforms", "cpu")
 
-    coordinator, pid = sys.argv[1], int(sys.argv[2])
+    coordinator, pid, mode = sys.argv[1], int(sys.argv[2]), sys.argv[3]
 
     from luminaai_tpu.config import Config
     from luminaai_tpu.models.transformer import LuminaTransformer
@@ -35,13 +35,20 @@ WORKER = textwrap.dedent("""
     from luminaai_tpu.parallel.train_step import make_train_step
     from luminaai_tpu.training.optimizer import make_optimizer, make_schedule
 
+    extra = (
+        # 1F1B pipeline stages SPANNING the process boundary: every tick's
+        # activation/cotangent ppermute is a cross-process collective.
+        dict(pipeline_parallel_size=2, scan_layers=True)
+        if mode == "pipe"
+        else dict(fsdp_parallel_size=2)
+    )
     cfg = Config(
         vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
         num_kv_heads=1, seq_length=32, batch_size=8,
         use_flash_attention=False, gradient_checkpointing=False,
-        precision="fp32", fsdp_parallel_size=2,
+        precision="fp32",
         multihost=True, coordinator_address=coordinator,
-        num_processes=2, process_id=pid,
+        num_processes=2, process_id=pid, **extra,
     )
     initialize_multihost(cfg)
     assert jax.device_count() == 8, jax.device_count()
@@ -88,13 +95,14 @@ def _free_port() -> int:
     return port
 
 
-def test_two_process_distributed_train_step(tmp_path):
+@pytest.mark.parametrize("mode", ["fsdp", "pipe"])
+def test_two_process_distributed_train_step(tmp_path, mode):
     coordinator = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
     procs = [
         subprocess.Popen(
-            [sys.executable, "-c", WORKER, coordinator, str(pid)],
+            [sys.executable, "-c", WORKER, coordinator, str(pid), mode],
             env=env,
             cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
             stdout=subprocess.PIPE,
