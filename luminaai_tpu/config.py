@@ -50,8 +50,8 @@ class Config:
     use_stable_embedding: bool = True
     init_std: float = 0.02
     use_flash_attention: bool = True
-    flash_block_q: int = 512
-    flash_block_kv: int = 512
+    flash_block_q: int = 1024
+    flash_block_kv: int = 1024
 
     # --- MoE ---
     use_moe: bool = False
@@ -129,7 +129,7 @@ class Config:
     #   bf16 per layer) so the backward recomputes only the branch being
     #   differentiated — most of dots_saveable's win at ~1% of its HBM;
     # dots_saveable = store every matmul output; full = no remat.
-    remat_policy: str = "nothing_saveable"  # nothing_saveable|save_outs|dots_saveable|full
+    remat_policy: str = "nothing_saveable"  # nothing_saveable|save_outs|save_attn|dots_saveable|full
     # Adam first-moment dtype: None = fp32; 'bf16' halves mu's HBM
     # (2 bytes/param) — nu stays fp32 (variance needs the exponent range).
     adam_mu_dtype: Optional[str] = None
@@ -348,7 +348,8 @@ class Config:
             )
         assert self.loss_chunk_size > 0, "loss_chunk_size must be positive"
         assert self.remat_policy in (
-            "nothing_saveable", "save_outs", "dots_saveable", "full",
+            "nothing_saveable", "save_outs", "save_attn", "dots_saveable",
+            "full",
         ), f"invalid remat_policy {self.remat_policy}"
         assert self.adam_mu_dtype in (None, "bf16"), (
             f"invalid adam_mu_dtype {self.adam_mu_dtype}"

@@ -23,6 +23,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -317,7 +318,15 @@ def _flash_lse_fwd(q, k, v, scale, causal, block_q, block_kv):
     # kernel's [B, Hq, Sq, 128] layout (256MB-scale at flagship shapes) —
     # the lane-padded buffer lives only inside this fwd call (r1 OOM fix).
     lse_small = lse[..., 0]
-    return (out, lse_small), (q, k, v, out, lse_small)
+    # checkpoint_name on the residuals: under the 'save_attn' remat policy
+    # (models/transformer.py REMAT_POLICIES) these are stored across the
+    # fwd/bwd boundary, so the branch backward rebuilds only the cheap
+    # q/k/v projections and the forward flash kernel is never re-executed.
+    # Under other policies the tags are inert. (Same mechanism as splash
+    # attention's residual_checkpoint_name.)
+    out_r = checkpoint_name(out, "flash_out")
+    lse_r = checkpoint_name(lse_small, "flash_lse")
+    return (out_r, lse_r), (q, k, v, out_r, lse_r)
 
 
 def _flash_lse_bwd(scale, causal, block_q, block_kv, res, g):
@@ -326,6 +335,16 @@ def _flash_lse_bwd(scale, causal, block_q, block_kv, res, g):
 
 
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def fit_block(seq_len: int, want: int) -> int:
+    """Largest block <= `want` that divides seq_len (halving search, so a
+    power-of-two `want` degrades 1024 -> 512 -> ... for lengths like 1536
+    that are divisible by a smaller power of two). Returns >= 1."""
+    b = max(1, min(want, seq_len))
+    while seq_len % b:
+        b //= 2
+    return b
 
 
 def flash_attention_with_lse(
@@ -342,15 +361,22 @@ def flash_attention_with_lse(
 
     The (out, lse) pair makes chunks mergeable with the online-softmax
     recurrence — ring attention combines per-ring-step chunk results this
-    way (ops/ring_attention.py). Differentiable in both outputs.
+    way (ops/ring_attention.py). Differentiable in both outputs. Block
+    sizes self-fit to the sequence lengths (largest divisor <= requested),
+    so any length flash_eligible admits runs without caller-side tuning.
     """
     B, Sq, Hq, D = q.shape
     Skv, Hkv = k.shape[1], k.shape[2]
     assert Hq % Hkv == 0, "num q heads must be a multiple of kv heads"
-    block_q = min(block_q, Sq)
-    block_kv = min(block_kv, Skv)
-    assert Sq % block_q == 0 and Skv % block_kv == 0, (
-        f"seq lengths ({Sq},{Skv}) must divide block sizes ({block_q},{block_kv})"
+    block_q = fit_block(Sq, block_q)
+    block_kv = fit_block(Skv, block_kv)
+    # Degenerate fits (odd lengths halve all the way down) would compile a
+    # pathologically fine grid — fail loudly instead; flash_eligible is the
+    # caller-side gate with the same rule.
+    assert block_q >= 128 and block_kv >= 128, (
+        f"no usable flash block for seq lengths ({Sq},{Skv}); largest "
+        f"fitting blocks ({block_q},{block_kv}) < 128 — gate calls with "
+        "flash_eligible() and fall back to the XLA path"
     )
     if scale is None:
         scale = 1.0 / (D**0.5)
@@ -362,12 +388,14 @@ def flash_eligible(
 ) -> bool:
     """Single source of truth for when the Pallas kernel applies:
     long-enough sequence, lane-friendly head_dim (Mosaic pads 64→128 lanes;
-    below 64 the pad waste dominates), and blocks that divide the length."""
+    below 64 the pad waste dominates), and a usable block fit — the kernel
+    self-fits blocks downward, but below 128 the grid overhead beats the
+    XLA fallback."""
     return (
         seq_len >= 128
         and head_dim % 64 == 0
-        and seq_len % min(block_q, seq_len) == 0
-        and seq_len % min(block_kv, seq_len) == 0
+        and fit_block(seq_len, block_q) >= 128
+        and fit_block(seq_len, block_kv) >= 128
     )
 
 
@@ -383,8 +411,10 @@ def flash_attention(
 ) -> jax.Array:
     """Flash attention over [B, S, H, D] tensors (differentiable).
 
-    Supports GQA (k/v may have fewer heads than q). The block sizes must
-    divide the sequence lengths; head_dim should be a multiple of 64.
+    Supports GQA (k/v may have fewer heads than q). Block sizes self-fit
+    downward to the largest divisor of the sequence length (>= 128, else
+    this raises — gate with flash_eligible); head_dim should be a multiple
+    of 64.
     """
     return flash_attention_with_lse(
         q, k, v, causal=causal, scale=scale,

@@ -275,9 +275,10 @@ def prepend_soft_prompt(
         ):
             logging.getLogger(__name__).warning(
                 "soft prompt of %d tokens makes seq %d flash-ineligible "
-                "(blocks %d/%d) — attention falls back to the O(S^2) XLA "
-                "path; pick P so S+P divides the flash blocks",
-                P, S + P, cfg.flash_block_q, cfg.flash_block_kv,
+                "(no block divisor >= 128) — attention falls back to the "
+                "O(S^2) XLA path; pick P so S+P has a divisor >= 128 that "
+                "is <= the configured flash blocks (e.g. a multiple of 128)",
+                P, S + P,
             )
     logits, aux = model.apply(
         {"params": params}, input_ids, prefix_embeds=prompt[None].repeat(B, 0)

@@ -23,7 +23,8 @@ VARIANTS = {
     "scan": {"scan_layers": True},
     "einsum": {"moe_dispatch": "einsum"},
     "chunk512": {"loss_chunk_size": 512},
-    "blk1024": {"flash_block_kv": 1024},
+    # 1024 became the Config default in r3; this A/Bs the old 512 blocks.
+    "blk512": {"flash_block_q": 512, "flash_block_kv": 512},
     "noflash": {"use_flash_attention": False},
     "scan_dots": {"scan_layers": True, "remat_policy": "dots_saveable"},
     "gatherd": {"moe_dispatch": "gather"},
@@ -46,6 +47,37 @@ VARIANTS = {
         "batch_size": 24,
         "micro_batch_size": None,
         "remat_policy": "save_outs",
+        "moe_dispatch": "gather",
+        "adam_state_quantization": "int8",
+    },
+    # r3 on-chip round: save_attn keeps the flash (out, lse) residuals so
+    # the backward never re-runs the forward attention kernel (~115ms/step
+    # in the r3 trace); blk512 A/Bs the old block size against the new
+    # 1024 default; q8 frees optimizer HBM for the saved residuals.
+    "attn": {"remat_policy": "save_attn", "moe_dispatch": "gather"},
+    "attn_blk512": {
+        "remat_policy": "save_attn",
+        "moe_dispatch": "gather",
+        "flash_block_q": 512,
+        "flash_block_kv": 512,
+    },
+    "b24_attn_gather": {
+        "batch_size": 24,
+        "micro_batch_size": None,
+        "remat_policy": "save_attn",
+        "moe_dispatch": "gather",
+    },
+    "b24_q8_attn_gather": {
+        "batch_size": 24,
+        "micro_batch_size": None,
+        "remat_policy": "save_attn",
+        "moe_dispatch": "gather",
+        "adam_state_quantization": "int8",
+    },
+    "b32_q8_attn_gather": {
+        "batch_size": 32,
+        "micro_batch_size": None,
+        "remat_policy": "save_attn",
         "moe_dispatch": "gather",
         "adam_state_quantization": "int8",
     },

@@ -1,6 +1,12 @@
 #!/bin/bash
-# Probe the TPU tunnel every 5 minutes; when it answers, run the perf sweep
-# and leave results in scripts/sweep_out.txt. Single-shot: exits after sweep.
+# Probe the TPU tunnel every 5 minutes; when it answers, run the queued
+# on-chip work and leave results in scripts/sweep_out3.txt. Single-shot:
+# exits after the queue drains.
+#
+# r3 queue (tunnel died mid-session after the save_attn lever was timed at
+# 31.6k tok/s): finish the batch/q8 composition sweep, capture the bench.py
+# artifact with the new ref-matched headline rung, then the op/serving
+# benches.
 cd /root/repo
 PROBE='import jax, jax.numpy as jnp
 x = jnp.ones((1024, 1024), jnp.bfloat16)
@@ -11,17 +17,16 @@ while true; do
   # pipe open, deadlocking the whole loop — KILL it after a grace period.
   out=$(timeout -k 10 90 python -c "$PROBE" 2>/dev/null)
   if echo "$out" | grep -q "PROBE_OK tpu"; then
-    echo "$(date -u +%FT%TZ) tunnel up, starting sweep" >> scripts/sweep_out.txt
-    # Likely winners first so a late recovery still yields an A/B.
-    timeout 4500 python scripts/perf_sweep.py base saveouts_gather b24_saveouts_gather b24_q8_saveouts_gather q8 gatherd saveouts chunk1024 mu16 scan >> scripts/sweep_out.txt 2>&1
-    echo "$(date -u +%FT%TZ) sweep done rc=$?" >> scripts/sweep_out.txt
-    echo "$(date -u +%FT%TZ) bench_ops" >> scripts/sweep_out.txt
-    timeout 2400 python bench_ops.py >> scripts/sweep_out.txt 2>&1
-    echo "$(date -u +%FT%TZ) serve_bench" >> scripts/sweep_out.txt
-    timeout 1800 python scripts/serve_bench.py 2 4 8 >> scripts/sweep_out.txt 2>&1
-    echo "$(date -u +%FT%TZ) bench.py (early TPU artifact in case the tunnel dies again)" >> scripts/sweep_out.txt
-    timeout 3600 python bench.py >> scripts/sweep_out.txt 2>&1
-    echo "$(date -u +%FT%TZ) all done" >> scripts/sweep_out.txt
+    echo "$(date -u +%FT%TZ) tunnel up" >> scripts/sweep_out3.txt
+    echo "$(date -u +%FT%TZ) bench.py first (headline artifact before anything can wedge)" >> scripts/sweep_out3.txt
+    timeout -k 30 4200 python bench.py >> scripts/sweep_out3.txt 2>&1
+    echo "$(date -u +%FT%TZ) bench.py rc=$?" >> scripts/sweep_out3.txt
+    timeout -k 30 2400 python scripts/perf_sweep.py b24_q8_attn_gather b32_q8_attn_gather attn_blk512 >> scripts/sweep_out3.txt 2>&1
+    echo "$(date -u +%FT%TZ) sweep rc=$?" >> scripts/sweep_out3.txt
+    timeout -k 30 2400 python bench_ops.py >> scripts/sweep_out3.txt 2>&1
+    echo "$(date -u +%FT%TZ) bench_ops rc=$?" >> scripts/sweep_out3.txt
+    timeout -k 30 1800 python scripts/serve_bench.py 2 4 8 >> scripts/sweep_out3.txt 2>&1
+    echo "$(date -u +%FT%TZ) all done" >> scripts/sweep_out3.txt
     exit 0
   fi
   echo "$(date -u +%FT%TZ) tunnel down" >> scripts/watcher_log.txt

@@ -283,7 +283,41 @@ class TestRematPolicies:
             return jax.grad(loss)(variables["params"])
 
         ref = grads_for("nothing_saveable")
-        for policy in ("save_outs", "dots_saveable"):
+        for policy in ("save_outs", "save_attn", "dots_saveable"):
             g = grads_for(policy)
             for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(g)):
                 assert jnp.allclose(a, b, atol=1e-5), policy
+
+    def test_save_attn_parity_with_flash_kernel(self):
+        """save_attn's saved (out, lse) residuals come from checkpoint_name
+        tags inside the flash custom_vjp fwd — parity must hold with the
+        Pallas kernel actually on (interpret mode on CPU), where the saved
+        residuals replace the recomputed forward in the backward pass."""
+        rng = jax.random.PRNGKey(1)
+        cfg = tiny_config(
+            gradient_checkpointing=True,
+            use_flash_attention=True,
+            flash_block_q=128,
+            flash_block_kv=128,
+            seq_length=256,
+            num_heads=2,
+            num_kv_heads=1,
+            hidden_size=128,  # head_dim 64: flash_eligible
+        )
+        ids = jax.random.randint(rng, (2, cfg.seq_length), 0, cfg.vocab_size)
+
+        def grads_for(policy):
+            c = dataclasses.replace(cfg, remat_policy=policy)
+            model = LuminaTransformer(c)
+            variables = model.init({"params": rng}, ids)
+
+            def loss(p):
+                lg, aux = model.apply({"params": p}, ids)
+                return lg.astype(jnp.float32).mean() + aux["aux_loss"]
+
+            return jax.grad(loss)(variables["params"])
+
+        ref = grads_for("save_outs")
+        g = grads_for("save_attn")
+        for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(g)):
+            assert jnp.allclose(a, b, atol=1e-6)
