@@ -329,9 +329,29 @@ class Config:
                 f"invalid moe_pattern {self.moe_pattern}"
             )
             assert self.capacity_factor > 0
-            assert self.moe_dispatch in ("sort", "gather", "einsum"), (
+            assert self.moe_dispatch in ("sort", "gather", "einsum", "gmm"), (
                 f"invalid moe_dispatch {self.moe_dispatch}"
             )
+            if self.moe_dispatch == "gmm":
+                # The megablox grouped-matmul kernel is a Pallas custom
+                # call GSPMD cannot partition, and the global expert-sort
+                # crosses the batch axis — under ANY multi-chip sharding
+                # XLA would all-gather/replicate the full token buffers,
+                # silently erasing the parallelism. Single-chip only
+                # (make_train_step enforces mesh.size == 1 for the
+                # inferred-dp case); use 'gather'/'sort' on meshes.
+                for name, size in (
+                    ("expert", self.expert_parallel_size),
+                    ("pipeline", self.pipeline_parallel_size),
+                    ("sequence", self.sequence_parallel_size),
+                    ("tensor", self.tensor_parallel_size),
+                    ("fsdp", self.fsdp_parallel_size),
+                ):
+                    assert size == 1, (
+                        f"moe_dispatch='gmm' is single-chip only "
+                        f"({name}_parallel_size={size}); use 'gather' or "
+                        "'sort' for sharded meshes"
+                    )
             assert 0.0 <= self.expert_dropout_rate <= 0.5, (
                 "expert_dropout_rate must be in [0, 0.5]"
             )

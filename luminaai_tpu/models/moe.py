@@ -220,7 +220,17 @@ class MoELayer(nn.Module):
             gate_logits = jnp.where(keep[None, None, :], gate_logits, -1e9)
         router_probs = jax.nn.softmax(gate_logits, axis=-1)
 
-        if cfg.moe_dispatch in ("sort", "gather"):
+        if cfg.moe_dispatch == "gmm":
+            # Ragged grouped matmul via the Pallas megablox kernel: tokens
+            # sorted by expert, each expert's FFN runs over exactly its
+            # kept rows — no [E, G, C, H] capacity-padded buffers and no
+            # padded-slot FLOPs (~20% of expert matmul work at cf 1.25).
+            # Routing/capacity/drop semantics are _sort_routing's, so
+            # outputs match the sort/gather paths exactly.
+            out, tokens_per_expert, dropped = self._gmm_path(
+                x, router_probs, wi, wo, capacity
+            )
+        elif cfg.moe_dispatch in ("sort", "gather"):
             # Sort-based dispatch: scatter/gather via flat slot ids — no
             # [G,S,E,C] one-hot tensors (see _sort_routing). The expert FFN
             # below still runs dense [E,G,C,·] matmuls on the MXU.
@@ -234,7 +244,10 @@ class MoELayer(nn.Module):
 
             if cfg.moe_dispatch == "gather":
                 # Invert slot→token into an index table first (cheap int32
-                # scatter), then fill the expert buffers with a row GATHER.
+                # scatter), then fill the expert buffers with a row GATHER
+                # — directly in the [E, G, C, H] expert-major layout, so no
+                # [G, E·C, H]→[E, G, C, H] activation transpose ever
+                # materializes (the int32 index transpose is ~KB-scale).
                 # TPU executes H-wide row gathers far better than row
                 # scatters; the H-wide scatter-add moves to the backward,
                 # where the combine path's gather VJP was already one.
@@ -245,18 +258,18 @@ class MoELayer(nn.Module):
                     )[: E * capacity]
 
                 inv = jax.vmap(invert_group)(slot)  # [G, E*C] token ids
+                inv_egc = inv.reshape(G, E, capacity).transpose(1, 0, 2)
                 # Unfilled slots (inv == S) gather an arbitrary row and are
                 # zeroed by the mask — avoids concatenating a zero row onto
                 # x (a whole-activation HBM copy per layer).
-                filled = (inv < S)[..., None].astype(self.dtype)
-                buf = (
-                    jnp.take_along_axis(
-                        x.astype(self.dtype),
-                        jnp.minimum(inv, S - 1)[..., None],
-                        axis=1,
-                    )
+                filled = (inv_egc < S)[..., None].astype(self.dtype)
+                expert_in = (
+                    x.astype(self.dtype)[
+                        jnp.arange(G)[None, :, None],
+                        jnp.minimum(inv_egc, S - 1),
+                    ]
                     * filled
-                )  # [G, E*C, H]
+                )  # [E, G, C, H]
             else:
 
                 def scatter_group(xg, slot_g):
@@ -266,7 +279,9 @@ class MoELayer(nn.Module):
 
                 buf = jax.vmap(scatter_group)(x.astype(self.dtype), slot)
                 buf = buf[:, : E * capacity]
-            expert_in = buf.reshape(G, E, capacity, H).transpose(1, 0, 2, 3)
+                expert_in = buf.reshape(G, E, capacity, H).transpose(
+                    1, 0, 2, 3
+                )
             tokens_per_expert = counts.astype(jnp.float32).sum(axis=0)
         else:
             dispatch, combine_w, dropped = _top_k_routing(
@@ -279,59 +294,59 @@ class MoELayer(nn.Module):
                 "gsec->e", dispatch.astype(jnp.float32)
             )
 
-        # Manual expert parallelism (inside the 1F1B manual-pipe region):
-        # tokens arrive SHARDED over the 'expert' mesh axis (ep borrows the
-        # data dimension, the DeepSpeed-MoE layout), this shard's wi/wo
-        # hold only E/ep experts, and a tiled all-to-all exchanges token
-        # buffers so each shard runs its experts over every shard's tokens.
-        manual_ep = cfg.moe_manual_ep and cfg.expert_parallel_size > 1
-        if manual_ep:
-            # [E, G, C, H] -> [E/ep, ep*G, C, H]: split experts to their
-            # owners, gather all shards' token groups.
-            expert_in = jax.lax.all_to_all(
-                expert_in, "expert", split_axis=0, concat_axis=1, tiled=True
-            )
-        elif cfg.moe_ep_constraints:
-            # Force the all-to-all dispatch layout: activations sharded
-            # over 'expert' so each shard runs only its experts' matmuls.
-            # Skipped inside the 1F1B manual-pipe region, where the
-            # explicit reshard trips XLA's SPMD partitioner group check.
-            expert_in = nn.with_logical_constraint(
-                expert_in, ("expert", "activation_exp_batch", None, None)
-            )
-        fused = jnp.einsum("egch,ehf->egcf", expert_in, wi.astype(self.dtype))
-        gate_act, up = jnp.split(fused, 2, axis=-1)
-        act = nn.silu(gate_act) * up
-        expert_out = jnp.einsum("egcf,efh->egch", act, wo.astype(self.dtype))
-        if manual_ep:
-            # [E/ep, ep*G, C, H] -> [E, G, C, H]: every token group gets
-            # all experts' outputs back for the local combine.
-            expert_out = jax.lax.all_to_all(
-                expert_out, "expert", split_axis=1, concat_axis=0, tiled=True
-            )
-        elif cfg.moe_ep_constraints:
-            expert_out = nn.with_logical_constraint(
-                expert_out, ("expert", "activation_exp_batch", None, None)
-            )
+        if cfg.moe_dispatch != "gmm":
+            # Manual expert parallelism (inside the 1F1B manual-pipe region):
+            # tokens arrive SHARDED over the 'expert' mesh axis (ep borrows the
+            # data dimension, the DeepSpeed-MoE layout), this shard's wi/wo
+            # hold only E/ep experts, and a tiled all-to-all exchanges token
+            # buffers so each shard runs its experts over every shard's tokens.
+            manual_ep = cfg.moe_manual_ep and cfg.expert_parallel_size > 1
+            if manual_ep:
+                # [E, G, C, H] -> [E/ep, ep*G, C, H]: split experts to their
+                # owners, gather all shards' token groups.
+                expert_in = jax.lax.all_to_all(
+                    expert_in, "expert", split_axis=0, concat_axis=1, tiled=True
+                )
+            elif cfg.moe_ep_constraints:
+                # Force the all-to-all dispatch layout: activations sharded
+                # over 'expert' so each shard runs only its experts' matmuls.
+                # Skipped inside the 1F1B manual-pipe region, where the
+                # explicit reshard trips XLA's SPMD partitioner group check.
+                expert_in = nn.with_logical_constraint(
+                    expert_in, ("expert", "activation_exp_batch", None, None)
+                )
+            fused = jnp.einsum("egch,ehf->egcf", expert_in, wi.astype(self.dtype))
+            gate_act, up = jnp.split(fused, 2, axis=-1)
+            act = nn.silu(gate_act) * up
+            expert_out = jnp.einsum("egcf,efh->egch", act, wo.astype(self.dtype))
+            if manual_ep:
+                # [E/ep, ep*G, C, H] -> [E, G, C, H]: every token group gets
+                # all experts' outputs back for the local combine.
+                expert_out = jax.lax.all_to_all(
+                    expert_out, "expert", split_axis=1, concat_axis=0, tiled=True
+                )
+            elif cfg.moe_ep_constraints:
+                expert_out = nn.with_logical_constraint(
+                    expert_out, ("expert", "activation_exp_batch", None, None)
+                )
 
-        if cfg.moe_dispatch in ("sort", "gather"):
-            out_flat = expert_out.transpose(1, 0, 2, 3).reshape(
-                G, E * capacity, H
-            )
-
-            def combine_group(of, slot_g, gate_g):
+            if cfg.moe_dispatch in ("sort", "gather"):
                 # Dropped pairs carry slot == E*C (one past the end) AND
-                # gate == 0: clamping the index gathers an arbitrary row
-                # that the zero gate annihilates — no zero-row concatenate
-                # (a full [G, E*C, H] HBM copy per layer, ~57ms/step in the
-                # r3 flagship trace).
-                idx = jnp.minimum(slot_g.reshape(-1), E * capacity - 1)
-                y = of[idx].reshape(S, k, H)
-                return jnp.einsum("skh,sk->sh", y, gate_g)
-
-            out = jax.vmap(combine_group)(out_flat, slot, gate)
-        else:
-            out = jnp.einsum("gsec,egch->gsh", combine_w, expert_out)
+                # gate == 0: clamping the index gathers an arbitrary row that
+                # the zero gate annihilates — no zero-row concatenate (a full
+                # [G, E*C, H] HBM copy per layer, ~57ms/step in the r3
+                # flagship trace). The gather indexes expert_out's [E, G, C]
+                # layout directly, so no expert-major→token-major activation
+                # transpose materializes either.
+                sl = jnp.minimum(slot, E * capacity - 1)  # [G, S, k]
+                y = expert_out[
+                    sl // capacity,
+                    jnp.arange(G)[:, None, None],
+                    sl % capacity,
+                ]  # [G, S, k, H]
+                out = jnp.einsum("gskh,gsk->gsh", y, gate)
+            else:
+                out = jnp.einsum("gsec,egch->gsh", combine_w, expert_out)
         if cfg.expert_output_scaling != 1.0:
             out = out * cfg.expert_output_scaling
 
@@ -364,3 +379,90 @@ class MoELayer(nn.Module):
             "expert_utilization": f * E,  # 1.0 == perfectly balanced
         }
         return out.astype(self.dtype), metrics
+
+    def _gmm_path(
+        self, x: jax.Array, router_probs: jax.Array, wi, wo, capacity: int
+    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """Ragged expert FFN via the Pallas megablox grouped matmul.
+
+        Tokens are globally sorted by assigned expert; each expert's two
+        matmuls run over exactly its kept rows ([N_kept, H] x [H, 2F]),
+        so the capacity-padded [E, G, C, ·] buffers of the sort/gather
+        paths — and the ~cf·k/E-1 fraction of wasted padded-slot FLOPs —
+        never exist. Routing (slots, gates, drops, per-group capacity)
+        comes from the same _sort_routing, so outputs and stats match the
+        other dispatch modes exactly. (The TPU counterpart of the ref's
+        grouped CUDA expert kernels, Src/Main_Scripts/core/
+        moe_cuda_wrapper.py:628.)
+
+        Returns (combined_out [G,S,H], tokens_per_expert [E], dropped [G,S]).
+        """
+        cfg = self.config
+        G, S, H = x.shape
+        E, k = cfg.num_experts, cfg.moe_top_k
+        C = capacity
+        N = G * S * k
+        assert N % 128 == 0, (
+            f"gmm dispatch needs groups*seq*top_k ({N}) to be a multiple "
+            "of the 128-row kernel tile; use 'gather' dispatch for this "
+            "shape"
+        )
+        on_tpu = jax.default_backend() == "tpu"
+        if on_tpu:
+            from jax.experimental.pallas.ops.tpu.megablox import gmm
+        else:
+            # Megablox's interpret mode is minutes-per-call even at test
+            # sizes; off-TPU a masked-matmul reference keeps the whole
+            # routing/sort/combine logic under CPU test with identical
+            # math (one dense [N,·]x[·,·] matmul per expert).
+            def gmm(lhs, rhs, group_sizes, preferred_element_type, **_):
+                bounds = jnp.cumsum(group_sizes)
+                row_expert = jnp.searchsorted(
+                    bounds, jnp.arange(lhs.shape[0]), side="right"
+                )
+                out = jnp.zeros(
+                    (lhs.shape[0], rhs.shape[-1]), preferred_element_type
+                )
+                for e in range(rhs.shape[0]):
+                    sel = (row_expert == e)[:, None].astype(lhs.dtype)
+                    out = out + (
+                        (lhs * sel) @ rhs[e]
+                    ).astype(preferred_element_type)
+                return out
+
+        slot, gate, dropped, counts = _sort_routing(router_probs, k, C)
+        gate = gate.astype(self.dtype)
+
+        # Global pair -> expert; dropped pairs get sentinel E and sort
+        # after every real expert's run (excluded via group_sizes).
+        e_pair = jnp.where(slot < E * C, slot // C, E).reshape(-1)  # [N]
+        perm = jnp.argsort(e_pair, stable=True)  # [N] pair ids, expert-major
+        # Pair id p = ((g*S)+s)*k + r -> its token row in x_flat is p // k.
+        x_flat = x.astype(self.dtype).reshape(G * S, H)
+        lhs = x_flat[perm // k]  # [N, H] expert-sorted token rows
+        group_sizes = counts.sum(axis=0).astype(jnp.int32)  # [E] kept rows
+
+        fused = gmm(
+            lhs,
+            wi.astype(self.dtype),
+            group_sizes,
+            preferred_element_type=self.dtype,
+        )  # [N, 2F]
+        gate_act, up = jnp.split(fused, 2, axis=-1)
+        act = nn.silu(gate_act) * up
+        yrow = gmm(
+            act,
+            wo.astype(self.dtype),
+            group_sizes,
+            preferred_element_type=self.dtype,
+        )  # [N, H]
+        # Rows past the kept region are never stored by the kernel
+        # (uninitialized output tiles) — zero them before the unsort so
+        # garbage can't meet a NaN-propagating gate product.
+        total_kept = group_sizes.sum()
+        yrow = jnp.where(jnp.arange(N)[:, None] < total_kept, yrow, 0.0)
+
+        inv_perm = jnp.argsort(perm)  # back to pair order
+        y_pairs = yrow[inv_perm].reshape(G, S, k, H)
+        out = jnp.einsum("gskh,gsk->gsh", y_pairs, gate)
+        return out, group_sizes.astype(jnp.float32), dropped

@@ -338,9 +338,19 @@ _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def fit_block(seq_len: int, want: int) -> int:
-    """Largest block <= `want` that divides seq_len (halving search, so a
-    power-of-two `want` degrades 1024 -> 512 -> ... for lengths like 1536
-    that are divisible by a smaller power of two). Returns >= 1."""
+    """Largest lane-aligned block <= `want` that divides seq_len.
+
+    Scans multiples of 128 downward (clean Mosaic tiling; finds e.g. 768
+    for seq 1536 under a 1024 request, or 512 for seq 1024 under a 768
+    request). If no 128-multiple divides seq_len, falls back to a halving
+    search whose result may be < 128 — flash_eligible treats that as
+    ineligible and callers take the XLA path."""
+    b = min(want, seq_len)
+    b -= b % 128
+    while b >= 128 and seq_len % b:
+        b -= 128
+    if b >= 128:
+        return b
     b = max(1, min(want, seq_len))
     while seq_len % b:
         b //= 2

@@ -219,6 +219,14 @@ def make_train_step(
         return make_pipeline_train_step(
             config, model, state_shardings, mesh, schedule, tx
         )
+    if config.use_moe and config.moe_dispatch == "gmm" and mesh.size > 1:
+        # Config validation catches the explicit axes; this catches the
+        # inferred data axis (data_parallel_size=-1 resolving to >1).
+        raise ValueError(
+            "moe_dispatch='gmm' is single-chip only (the megablox Pallas "
+            f"call cannot be partitioned; mesh has {mesh.size} devices) — "
+            "use 'gather' or 'sort' dispatch on multi-chip meshes"
+        )
     loss_fn = loss_fn or make_loss_fn(config, model)
     accum = config.gradient_accumulation_steps
     bspec = NamedSharding(mesh, batch_spec())

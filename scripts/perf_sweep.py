@@ -81,6 +81,16 @@ VARIANTS = {
         "moe_dispatch": "gather",
         "adam_state_quantization": "int8",
     },
+    # Ragged grouped-matmul dispatch (megablox): no capacity-padded
+    # buffers, no padded-slot FLOPs (~20% of expert matmul work saved).
+    "gmm": {"moe_dispatch": "gmm", "remat_policy": "save_attn"},
+    "b24_q8_gmm_attn": {
+        "batch_size": 24,
+        "micro_batch_size": None,
+        "moe_dispatch": "gmm",
+        "remat_policy": "save_attn",
+        "adam_state_quantization": "int8",
+    },
 }
 
 names = sys.argv[1:] or ["base", "dots", "scan", "einsum"]

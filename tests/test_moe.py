@@ -195,7 +195,7 @@ class TestDispatchModes:
     def test_modes_equivalent(self):
         x = jax.random.normal(jax.random.PRNGKey(1), (2, 64, 64))
         ref_out, ref_m, ref_g = self._run("sort", x)
-        for mode in ("gather", "einsum"):
+        for mode in ("gather", "einsum", "gmm"):
             out, m, g = self._run(mode, x)
             np.testing.assert_allclose(
                 np.asarray(out), np.asarray(ref_out), atol=1e-5, rtol=1e-5
@@ -211,3 +211,28 @@ class TestDispatchModes:
                     np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4,
                     err_msg=f"grad mismatch {mode} at {ka}",
                 )
+
+    def test_gmm_matches_sort_under_capacity_pressure(self):
+        """gmm's ragged grouping must reproduce the exact per-group FIFO
+        capacity drops of _sort_routing (dropped pairs sort to the
+        sentinel tail and are excluded via group_sizes)."""
+        import dataclasses
+
+        x = jax.random.normal(jax.random.PRNGKey(3), (2, 64, 64))
+        outs, drops = {}, {}
+        for mode in ("sort", "gmm"):
+            cfg = dataclasses.replace(
+                moe_config(routing_noise_std=0.0),
+                moe_dispatch=mode,
+                capacity_factor=0.5,  # force real drops
+            )
+            layer = MoELayer(cfg, dtype=jnp.float32)
+            params = layer.init(jax.random.PRNGKey(0), x)
+            out, m = layer.apply(params, x)
+            outs[mode], drops[mode] = out, float(m["moe_drop_rate"])
+        assert drops["sort"] > 0.0  # pressure actually dropped pairs
+        assert drops["gmm"] == pytest.approx(drops["sort"], abs=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(outs["gmm"]), np.asarray(outs["sort"]),
+            atol=1e-5, rtol=1e-5,
+        )
