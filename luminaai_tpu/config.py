@@ -52,6 +52,11 @@ class Config:
     use_flash_attention: bool = True
     flash_block_q: int = 1024
     flash_block_kv: int = 1024
+    # RoPE rotation math: 'fp32' (exact tables; costs an fp32 [B,S,H,D]
+    # round-trip per q/k projection, ~70ms/step at flagship scale) or
+    # 'bf16' (rotation in the compute dtype; inputs/outputs are bf16-
+    # quantized either way, only the products round differently).
+    rope_dtype: str = "fp32"
 
     # --- MoE ---
     use_moe: bool = False
@@ -320,6 +325,9 @@ class Config:
             "num_heads must be divisible by num_kv_heads"
         )
         assert self.precision in PRECISIONS, f"invalid precision {self.precision}"
+        assert self.rope_dtype in ("fp32", "bf16"), (
+            f"invalid rope_dtype {self.rope_dtype}"
+        )
         assert self.lr_scheduler in LR_SCHEDULES, (
             f"invalid lr_scheduler {self.lr_scheduler}"
         )
@@ -346,6 +354,9 @@ class Config:
                     ("sequence", self.sequence_parallel_size),
                     ("tensor", self.tensor_parallel_size),
                     ("fsdp", self.fsdp_parallel_size),
+                    # -1 (inferred) passes here; make_train_step/
+                    # make_eval_step catch the resolved multi-device mesh.
+                    ("data", max(self.data_parallel_size, 1)),
                 ):
                     assert size == 1, (
                         f"moe_dispatch='gmm' is single-chip only "

@@ -92,23 +92,35 @@ def rope_frequencies(
 
 
 def apply_rope(
-    x: jax.Array, cos: jax.Array, sin: jax.Array, positions: Optional[jax.Array] = None
+    x: jax.Array,
+    cos: jax.Array,
+    sin: jax.Array,
+    positions: Optional[jax.Array] = None,
+    compute_dtype: Optional[Any] = None,
 ) -> jax.Array:
     """Rotate q/k (ref core/model.py:471 apply_rotary_pos_emb_optimized).
 
     x: [B, S, H, D]; cos/sin: [max_len, D//2]; positions: [B, S] (optional).
     Split-halves convention (x1 = x[..., :D/2], x2 = x[..., D/2:]).
+
+    compute_dtype: fp32 by default (exact table math; an [B,S,H,D] fp32
+    intermediate + convert per projection). Passing the model compute
+    dtype (bf16) does the rotation in bf16 — inputs and outputs are bf16-
+    quantized either way, so the only extra rounding is the products';
+    the r3 trace prices the fp32 round-trips at ~70ms/step at flagship
+    scale (config.rope_dtype sweeps this).
     """
     d2 = x.shape[-1] // 2
+    ct = jnp.float32 if compute_dtype is None else compute_dtype
     if positions is None:
         c = cos[None, : x.shape[1], None, :]
         s = sin[None, : x.shape[1], None, :]
     else:
         c = cos[positions][:, :, None, :]
         s = sin[positions][:, :, None, :]
-    x1, x2 = x[..., :d2], x[..., d2:]
-    x1f, x2f = x1.astype(jnp.float32), x2.astype(jnp.float32)
-    out = jnp.concatenate([x1f * c - x2f * s, x2f * c + x1f * s], axis=-1)
+    c, s = c.astype(ct), s.astype(ct)
+    x1, x2 = x[..., :d2].astype(ct), x[..., d2:].astype(ct)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
     return out.astype(x.dtype)
 
 
@@ -208,9 +220,30 @@ class GQAttention(nn.Module):
             jnp.float32,
         )
 
-        q = jnp.einsum("bsd,dhk->bshk", x, wq.astype(self.dtype))
-        k = jnp.einsum("bsd,dhk->bshk", x, wk.astype(self.dtype))
-        v = jnp.einsum("bsd,dhk->bshk", x, wv.astype(self.dtype))
+        if cfg.tensor_parallel_size == 1:
+            # One fused [H, (nq+2*nkv)*d] projection: three skinny matmuls
+            # leave the MXU underfed; the weight concat is parameter-sized
+            # (a few MB) and XLA folds it. Param tree stays wq/wk/wv so
+            # checkpoints are unchanged. Under tensor parallelism the
+            # concat axis mixes differently-sharded head dims (GSPMD would
+            # replicate the fused weight), so tp keeps the per-weight
+            # einsums below.
+            wqkv = jnp.concatenate(
+                [
+                    wq.reshape(H, n_q * d),
+                    wk.reshape(H, n_kv * d),
+                    wv.reshape(H, n_kv * d),
+                ],
+                axis=1,
+            ).astype(self.dtype)
+            qkv = jnp.einsum("bsd,df->bsf", x, wqkv)
+            q = qkv[..., : n_q * d].reshape(B, S, n_q, d)
+            k = qkv[..., n_q * d : (n_q + n_kv) * d].reshape(B, S, n_kv, d)
+            v = qkv[..., (n_q + n_kv) * d :].reshape(B, S, n_kv, d)
+        else:
+            q = jnp.einsum("bsd,dhk->bshk", x, wq.astype(self.dtype))
+            k = jnp.einsum("bsd,dhk->bshk", x, wk.astype(self.dtype))
+            v = jnp.einsum("bsd,dhk->bshk", x, wv.astype(self.dtype))
 
         # Runtime length can exceed cfg.seq_length (soft-prompt prefixes
         # prepend virtual tokens); the rope table covers whichever is larger.
@@ -220,8 +253,9 @@ class GQAttention(nn.Module):
             else max(cfg.seq_length, S)
         )
         cos, sin = rope_frequencies(d, max_len, cfg.rope_theta)
-        q = apply_rope(q, cos, sin, positions)
-        k = apply_rope(k, cos, sin, positions)
+        rope_ct = self.dtype if cfg.rope_dtype == "bf16" else jnp.float32
+        q = apply_rope(q, cos, sin, positions, compute_dtype=rope_ct)
+        k = apply_rope(k, cos, sin, positions, compute_dtype=rope_ct)
 
         new_cache = None
         if kv_cache is not None:

@@ -84,6 +84,17 @@ VARIANTS = {
     # Ragged grouped-matmul dispatch (megablox): no capacity-padded
     # buffers, no padded-slot FLOPs (~20% of expert matmul work saved).
     "gmm": {"moe_dispatch": "gmm", "remat_policy": "save_attn"},
+    # RoPE rotation in bf16 (kills the fp32 [B,S,H,D] round-trips).
+    "rope16": {
+        "rope_dtype": "bf16",
+        "remat_policy": "save_attn",
+        "moe_dispatch": "gather",
+    },
+    "rope16_gmm": {
+        "rope_dtype": "bf16",
+        "moe_dispatch": "gmm",
+        "remat_policy": "save_attn",
+    },
     "b24_q8_gmm_attn": {
         "batch_size": 24,
         "micro_batch_size": None,

@@ -212,6 +212,37 @@ class TestDispatchModes:
                     err_msg=f"grad mismatch {mode} at {ka}",
                 )
 
+    def test_megablox_kernel_matches_fallback_contract(self):
+        """The CPU fallback _gmm_path swaps in for megablox off-TPU; pin
+        the two to the same contract by running the REAL kernel in
+        interpret mode against the same grouped matmul (incl. grads via
+        its custom_vjp — the wrapper in megablox/ops.py, which a reader
+        of megablox/gmm.py alone would miss)."""
+        from jax.experimental.pallas.ops.tpu.megablox import gmm
+
+        rng = np.random.RandomState(0)
+        lhs = jnp.asarray(rng.randn(256, 64), jnp.float32)
+        rhs = jnp.asarray(rng.randn(4, 64, 96), jnp.float32)
+        gs = jnp.array([128, 0, 96, 32], jnp.int32)  # ragged + empty group
+        out = gmm(lhs, rhs, gs, preferred_element_type=jnp.float32,
+                  interpret=True)
+        bounds = np.cumsum(np.asarray(gs))
+        ref = np.concatenate([
+            np.asarray(lhs[(0 if e == 0 else bounds[e - 1]):bounds[e]])
+            @ np.asarray(rhs[e])
+            for e in range(4)
+        ])
+        np.testing.assert_allclose(
+            np.asarray(out)[: bounds[-1]], ref, atol=1e-4, rtol=1e-4
+        )
+        g = jax.grad(
+            lambda l: jnp.sum(
+                gmm(l, rhs, gs, preferred_element_type=jnp.float32,
+                    interpret=True) ** 2
+            )
+        )(lhs)
+        assert bool(jnp.isfinite(g).all())
+
     def test_gmm_matches_sort_under_capacity_pressure(self):
         """gmm's ragged grouping must reproduce the exact per-group FIFO
         capacity drops of _sort_routing (dropped pairs sort to the
