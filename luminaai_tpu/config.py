@@ -238,6 +238,12 @@ class Config:
     # Gradient-noise-driven effective-batch growth (recompiles + reshapes
     # the data contract; opt-in; ref trainer.py:1626).
     enable_batch_size_optimization: bool = False
+    # Phase-scheduled MoD compute ratio (ref Main.py mod_capacity_adaptation
+    # + trainer.py:1559 adjust_mod_capacity): spend more FFN compute early
+    # in training, taper as the model converges. Total steps split into
+    # len(schedule) equal phases; each change recompiles the step.
+    enable_mod_capacity_adaptation: bool = False
+    mod_capacity_schedule: tuple = (0.7, 0.5, 0.3)
     intervention_cooldown_steps: int = 200
 
     # --- Chinchilla scaling ---
@@ -369,6 +375,12 @@ class Config:
         if self.use_mod:
             assert 0.0 < self.mod_capacity_factor <= 1.0, (
                 "mod_capacity_factor must be in (0, 1]"
+            )
+            assert self.mod_capacity_schedule and all(
+                0.0 < c <= 1.0 for c in self.mod_capacity_schedule
+            ), (
+                "mod_capacity_schedule entries must be in (0, 1] "
+                f"(got {self.mod_capacity_schedule})"
             )
         if self.sequence_parallel_size > 1:
             assert self.seq_length % self.sequence_parallel_size == 0

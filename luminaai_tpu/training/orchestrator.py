@@ -756,6 +756,33 @@ class AdaptiveTrainingOrchestrator:
                     step=step,
                 )
 
+        if (
+            self.config.enable_mod_capacity_adaptation
+            and self.trainer.config.use_mod
+        ):
+            # Phase-scheduled MoD compute ratio (ref Main.py
+            # mod_capacity_adaptation: more computation early, aggressive
+            # savings late). Phases split total steps in thirds; fire only
+            # when the trainer's live value differs from the target so the
+            # recompile happens once per boundary.
+            sched = self.config.mod_capacity_schedule
+            phase = min(
+                len(sched) - 1,
+                int(len(sched) * step / max(1, self.trainer.total_steps)),
+            )
+            target = float(sched[phase])
+            if abs(self.trainer.config.mod_capacity_factor - target) > 1e-6:
+                return AdaptiveDecision(
+                    kind="mod_capacity",
+                    params={"new_value": target},
+                    reason=(
+                        f"training phase {phase + 1}/{len(sched)}: "
+                        f"scheduled MoD compute ratio {target}"
+                    ),
+                    confidence=0.8,
+                    step=step,
+                )
+
         if self.config.enable_adaptive_wd and in_body:
             # Slow sustained loss rise that never trips the spike/divergence
             # rules above: add regularization (ref trainer.py:1792's stated
@@ -850,6 +877,14 @@ class AdaptiveTrainingOrchestrator:
             elif kind == "batch_size":
                 applied = t.adjust_batch_size(
                     decision.params["new_value"], reason=decision.reason
+                )
+            elif kind == "mod_capacity":
+                t.adjust_mod_capacity(
+                    decision.params["new_value"], reason=decision.reason
+                )
+                applied = (
+                    t.config.mod_capacity_factor
+                    == decision.params["new_value"]
                 )
             elif kind == "expert_dropout":
                 t.enable_expert_dropout(

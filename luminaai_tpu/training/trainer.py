@@ -412,6 +412,48 @@ class Trainer:
              "from": old, "to": new_temp, "reason": reason}
         )
 
+    def mod_statistics(self) -> Dict[str, Any]:
+        """MoD routing efficiency snapshot (ref trainer.py:1583
+        get_mod_statistics): live compute ratio plus the observed recent
+        ratio and the implied dense-FFN compute savings."""
+        if not self.config.use_mod:
+            return {"error": "MoD not enabled"}
+        summary = self.monitor.collector.get_metric_summary(
+            "mod_compute_ratio"
+        )
+        ratio = summary.get("current", self.config.mod_capacity_factor)
+        return {
+            "configured_capacity": self.config.mod_capacity_factor,
+            "observed_compute_ratio": ratio,
+            "compute_savings_vs_dense_ffn": round(1.0 - ratio, 4),
+            "recent": summary,
+        }
+
+    def adjust_mod_capacity(self, new_capacity: float, reason: str = "") -> None:
+        """Adjust the MoD compute ratio during training (ref trainer.py:1559
+        adjust_mod_capacity): what fraction of tokens get the full FFN.
+        Capacity is a static shape inside the jit, so the step recompiles;
+        params are untouched (the router's weights don't depend on it)."""
+        cfg = self.config
+        if not cfg.use_mod:
+            logger.warning("cannot adjust MoD capacity: MoD not enabled")
+            return
+        new_capacity = float(new_capacity)
+        if not 0.0 < new_capacity <= 1.0:
+            raise ValueError(
+                f"mod_capacity_factor {new_capacity} not in (0, 1]"
+            )
+        old = cfg.mod_capacity_factor
+        cfg.mod_capacity_factor = new_capacity
+        self._rebuild_steps()
+        logger.warning(
+            "MoD capacity %.2f -> %.2f (%s)", old, new_capacity, reason
+        )
+        self._interventions.append(
+            {"step": self.global_step, "kind": "mod_capacity",
+             "from": old, "to": new_capacity, "reason": reason}
+        )
+
     def enable_expert_dropout(self, rate: float, reason: str = "") -> None:
         """Enable whole-expert dropout mid-run to break expert collapse
         (ref trainer.py:1495 enable_expert_dropout). rate=0 disables."""

@@ -303,6 +303,35 @@ def test_orchestrator_raises_weight_decay_on_loss_creep(tmp_path):
     t.close()
 
 
+def test_orchestrator_schedules_mod_capacity_by_phase(tmp_path):
+    """Phase-scheduled MoD compute ratio (ref Main.py
+    mod_capacity_adaptation + trainer.py:1559 adjust_mod_capacity): the
+    orchestrator walks the early/mid/late schedule as steps cross the
+    1/3 and 2/3 boundaries, one recompile per boundary, and the rebuilt
+    step runs."""
+    cfg = tiny_config(
+        tmp_path, use_mod=True, use_moe=False, max_steps=300,
+        min_override_threshold=0.2, enable_adaptive_lr=False,
+        enable_mod_capacity_adaptation=True,
+        mod_capacity_factor=0.7,  # already at the early-phase target
+    )
+    t = Trainer(cfg, train_data=patterned_data(cfg),
+                checkpoint_dir=str(tmp_path / "ckpt"))
+    orch = AdaptiveTrainingOrchestrator(t)
+    for i in range(5, 300, 5):
+        orch.on_metrics(i, {"loss": 1.0, "grad_norm": 1.0})
+    fired = [d for d in orch.decisions if d.kind == "mod_capacity" and d.applied]
+    targets = [d.params["new_value"] for d in fired]
+    assert targets == [0.5, 0.3], [d.to_dict() for d in orch.decisions]
+    assert cfg.mod_capacity_factor == 0.3
+    batch = t._put(next(patterned_data(cfg)()))
+    t.state, m = t.train_step(t.state, batch)
+    assert np.isfinite(float(m["loss"]))
+    stats = t.mod_statistics()
+    assert stats["configured_capacity"] == 0.3
+    t.close()
+
+
 # -- scaler ----------------------------------------------------------------
 def test_chinchilla_plan():
     cfg = Config(hidden_size=64, num_layers=2, num_heads=4, num_kv_heads=2,
