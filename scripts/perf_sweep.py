@@ -95,6 +95,15 @@ VARIANTS = {
         "moe_dispatch": "gmm",
         "remat_policy": "save_attn",
     },
+    # Long-context rung: same tokens/step as base at 4x the sequence
+    # length — shows the flash+remat long-context story on one chip.
+    "long8k": {
+        "seq_length": 8192,
+        "batch_size": 4,
+        "micro_batch_size": None,
+        "remat_policy": "save_attn",
+        "moe_dispatch": "gather",
+    },
     "b24_q8_gmm_attn": {
         "batch_size": 24,
         "micro_batch_size": None,

@@ -164,3 +164,41 @@ def test_sort_and_gather_dispatch_not_slower_than_einsum():
         times[engine] = (time.perf_counter() - t0) / n
     assert times["sort"] < times["einsum"] * 2.0, times
     assert times["gather"] < times["einsum"] * 2.0, times
+
+
+def test_save_attn_removes_flash_fwd_from_backward():
+    """The save_attn remat policy stores the flash (out, lse) residuals,
+    so the backward must contain one fewer pallas call per layer than
+    save_outs (fwd + dq + dkv vs fwd + recomputed-fwd + dq + dkv) —
+    ~115ms/step at flagship scale (BENCHMARKS.md r3). Counting calls in
+    the jaxpr pins the mechanism without hardware."""
+    import dataclasses
+
+    base = Config(
+        vocab_size=256, hidden_size=128, num_layers=2, num_heads=2,
+        num_kv_heads=1, seq_length=256, batch_size=2, precision="fp32",
+        use_flash_attention=True, gradient_checkpointing=True,
+        flash_block_q=128, flash_block_kv=128,
+    )
+    ids = jnp.asarray(
+        np.random.RandomState(0).randint(1, 256, (2, 256)), jnp.int32
+    )
+
+    def pallas_calls(policy):
+        cfg = dataclasses.replace(base, remat_policy=policy)
+        model = LuminaTransformer(cfg)
+        params = model.init(jax.random.key(0), ids)["params"]
+
+        def loss(p):
+            out, _ = model.apply({"params": p}, ids, deterministic=True)
+            return out.astype(jnp.float32).sum()
+
+        return str(jax.make_jaxpr(jax.grad(loss))(params)).count(
+            "pallas_call"
+        )
+
+    n_outs = pallas_calls("save_outs")
+    n_attn = pallas_calls("save_attn")
+    # 2 layers x 4 kernels vs 2 layers x 3 kernels.
+    assert n_outs == 8, n_outs
+    assert n_attn == 6, n_attn
