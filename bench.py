@@ -325,9 +325,9 @@ def _probe_backend(timeout: int = 90, tries: int = 2):
 
 def _run_child(name: str, timeout: int):
     """Run one ladder rung; returns (parsed_json | None, diagnostic_str)."""
-    from bench_common import run_child
+    from bench_common import compile_cache_env, run_child
 
-    env = dict(os.environ)
+    env = compile_cache_env()
     env["BENCH_CHILD_BUDGET_S"] = str(timeout)
     if name == "cpu_fallback":
         env["JAX_PLATFORMS"] = "cpu"

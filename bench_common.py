@@ -9,8 +9,33 @@ implementation here so the robustness behavior can't drift between them.
 from __future__ import annotations
 
 import json
+import os
 import subprocess
 from typing import Callable, Dict, List, Optional, Tuple
+
+# Persistent XLA compilation cache shared by every bench/sweep process:
+# flagship compiles cost 40-90s each through the tunnel, and sweeps re-jit
+# the same programs across child processes. Harmless where unsupported
+# (the cache is a no-op if the backend can't serialize executables).
+CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+_CACHE_VARS = {
+    "JAX_COMPILATION_CACHE_DIR": CACHE_DIR,
+    "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "5",
+}
+
+
+def compile_cache_env(env: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """Env dict (a copy) with the persistent compile cache configured."""
+    out = dict(os.environ if env is None else env)
+    for k, v in _CACHE_VARS.items():
+        out.setdefault(k, v)
+    return out
+
+
+def enable_compile_cache() -> None:
+    """In-process variant; call before first jax compilation."""
+    for k, v in _CACHE_VARS.items():
+        os.environ.setdefault(k, v)
 
 
 def run_child(

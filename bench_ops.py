@@ -201,7 +201,7 @@ def main() -> None:
     rows: List[Dict] = []
     platform = None
     errors: List[str] = []
-    from bench_common import run_child
+    from bench_common import compile_cache_env, run_child
 
     for suite in suites:
         cmd = [sys.executable, os.path.abspath(__file__),
@@ -210,6 +210,7 @@ def main() -> None:
             cmd, args.timeout,
             validate=lambda p: "results" in p,
             label=suite,
+            env=compile_cache_env(),
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
         if parsed is None:

@@ -3,6 +3,8 @@
 host-transfer sync. Usage: python perf_sweep.py [variant ...]"""
 import sys, time, gc
 sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+from bench_common import enable_compile_cache
+enable_compile_cache()  # before first jax compile
 import numpy as np
 import jax, jax.numpy as jnp
 
