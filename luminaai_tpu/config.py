@@ -57,6 +57,14 @@ class Config:
     # 'bf16' (rotation in the compute dtype; inputs/outputs are bf16-
     # quantized either way, only the products round differently).
     rope_dtype: str = "fp32"
+    # Sliding-window (local) attention: each position attends to at most
+    # the `attention_window` most recent positions (itself included).
+    # None = full causal. The flash kernels skip whole blocks outside the
+    # band, so long-context attention cost becomes O(S·W) instead of
+    # O(S²); decode masks the KV cache to the same window. A TPU-first
+    # capability beyond the reference's surface (its attention is always
+    # full causal).
+    attention_window: Optional[int] = None
 
     # --- MoE ---
     use_moe: bool = False
@@ -285,6 +293,9 @@ class Config:
             )
         if isinstance(self.mesh_axes, list):
             self.mesh_axes = tuple(self.mesh_axes)
+        if isinstance(self.mod_capacity_schedule, list):
+            # yaml/json round-trips tuples as lists
+            self.mod_capacity_schedule = tuple(self.mod_capacity_schedule)
         self.normalize_parallelism()
         self.validate()
 
@@ -334,6 +345,16 @@ class Config:
         assert self.rope_dtype in ("fp32", "bf16"), (
             f"invalid rope_dtype {self.rope_dtype}"
         )
+        if self.attention_window is not None:
+            assert self.attention_window > 0, (
+                f"attention_window must be positive, got "
+                f"{self.attention_window}"
+            )
+            assert not self.use_ring_attention, (
+                "attention_window does not compose with ring attention "
+                "(the ring already partitions the sequence; windowed "
+                "ring attention is not implemented)"
+            )
         assert self.lr_scheduler in LR_SCHEDULES, (
             f"invalid lr_scheduler {self.lr_scheduler}"
         )

@@ -355,6 +355,7 @@ class GQAttention(nn.Module):
                 causal=True,
                 block_q=cfg.flash_block_q,
                 block_kv=cfg.flash_block_kv,
+                window=cfg.attention_window,
             )
         else:
             out = self._xla_attention(q, k, v, kv_cache is not None, cache_index)
@@ -367,6 +368,8 @@ class GQAttention(nn.Module):
 
         Grouped heads handled by reshape [B,S,Kv,G,D] — XLA maps the group
         dim onto the MXU batch dims; no head replication materialized.
+        Honors config.attention_window (sliding window) in both the full
+        and the decode (KV cache) paths.
         """
         B, Sq, n_q, d = q.shape
         Skv, n_kv = k.shape[1], k.shape[2]
@@ -380,6 +383,9 @@ class GQAttention(nn.Module):
             q_pos = q_pos + cache_index
         k_pos = jnp.arange(Skv)[None, :]
         mask = q_pos >= k_pos
+        w = self.config.attention_window
+        if w is not None:
+            mask = jnp.logical_and(mask, q_pos - k_pos < w)
         logits = jnp.where(mask[None, None, None], logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
         out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)

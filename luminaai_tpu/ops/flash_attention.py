@@ -35,10 +35,68 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+# -- banded-grid geometry (shared by all three kernels) ----------------------
+# With a sliding window the kv (resp. q) grid axis is SHRUNK to the number
+# of blocks that can intersect any block's band, and an offset index map
+# slides the band along the diagonal: skipped out-of-band blocks then cost
+# neither grid steps nor K/V block DMA, making windowed attention O(S*W)
+# in both compute and HBM traffic (the splash-attention approach).
+def _kv_block_offset(i, block_q: int, block_kv: int, window: int):
+    """First kv block intersecting q block i's window band (traced-safe)."""
+    return jnp.maximum(0, i * block_q - window + 1) // block_kv
+
+
+def _q_block_offset(j, block_q: int, block_kv: int):
+    """First q block intersecting kv block j's causal region."""
+    return (j * block_kv) // block_q
+
+
+def _n_kv_steps(skv: int, block_q: int, block_kv: int, window: int) -> int:
+    n = skv // block_kv
+    if window:
+        n = min(n, (window + block_q - 2) // block_kv + 2)
+    return n
+
+
+def _n_q_steps(sq: int, block_q: int, block_kv: int, window: int) -> int:
+    n = sq // block_q
+    if window:
+        n = min(n, (window + block_kv - 2) // block_q + 2)
+    return n
+
+
+def _block_needed(q_start, kv_start, block_q, block_kv, causal, window,
+                  kv_limit):
+    """Does the (q block, kv block) pair intersect the attention band?"""
+    needed = (not causal) or (kv_start <= q_start + block_q - 1)
+    if window:
+        needed = jnp.logical_and(
+            needed, kv_start + block_kv - 1 >= q_start - window + 1
+        )
+        # Offset grids can run past the sequence end; those steps fetch a
+        # clamped block and must not compute.
+        needed = jnp.logical_and(needed, kv_start < kv_limit)
+    return needed
+
+
+def _band_mask(s, q_start, kv_start, block_q, block_kv, window):
+    """In-block causal(+window) masking of the [block_q, block_kv] scores."""
+    q_pos = q_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_kv), 0
+    )
+    k_pos = kv_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_kv), 1
+    )
+    keep = q_pos >= k_pos
+    if window:
+        keep = jnp.logical_and(keep, q_pos - k_pos < window)
+    return jnp.where(keep, s, NEG_INF)
+
+
 # ---------------------------------------------------------------------------
 # Forward
 # ---------------------------------------------------------------------------
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, scale, block_q, block_kv, causal):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, scale, block_q, block_kv, causal, window, skv):
     j = pl.program_id(3)
     nj = pl.num_programs(3)
     i = pl.program_id(2)
@@ -50,9 +108,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, s
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     q_start = i * block_q
-    kv_start = j * block_kv
-    # Causal: skip blocks strictly above the diagonal band.
-    needed = (not causal) or (kv_start <= q_start + block_q - 1)
+    # Banded grid under a window: grid step j maps to kv block offset+j
+    # (the same formula as the K/V BlockSpec index maps).
+    jv = _kv_block_offset(i, block_q, block_kv, window) + j if window else j
+    kv_start = jv * block_kv
+    needed = _block_needed(
+        q_start, kv_start, block_q, block_kv, causal, window, skv
+    )
 
     @pl.when(needed)
     def _compute():
@@ -66,9 +128,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, s
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [bq, bkv] fp32
         if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
-            k_pos = kv_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = _band_mask(s, q_start, kv_start, block_q, block_kv, window)
         m_prev = m_scr[:, :]  # [bq, 128] lane-replicated running max
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1)[:, None])
         alpha = jnp.exp(m_prev - m_new)
@@ -88,24 +148,38 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *, s
         lse_ref[0, 0, :, :] = m_scr[:, :] + jnp.log(safe_l)
 
 
-def _fwd(q, k, v, *, scale, causal, block_q, block_kv):
+def _kv_index_map(group, block_q, block_kv, window, n_kv):
+    """K/V BlockSpec index map: banded offset under a window (clamped to
+    the last block; clamped steps are compute-skipped via _block_needed)."""
+    if not window:
+        return lambda b, h, i, j: (b, h // group, j, 0)
+
+    def index(b, h, i, j):
+        jv = _kv_block_offset(i, block_q, block_kv, window) + j
+        return (b, h // group, jnp.minimum(jv, n_kv - 1), 0)
+
+    return index
+
+
+def _fwd(q, k, v, *, scale, causal, block_q, block_kv, window=0):
     B, Sq, Hq, D = q.shape
     _, Skv, Hkv, _ = k.shape
     group = Hq // Hkv
     qt = q.transpose(0, 2, 1, 3)  # [B, Hq, Sq, D]
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    grid = (B, Hq, Sq // block_q, Skv // block_kv)
+    grid = (B, Hq, Sq // block_q, _n_kv_steps(Skv, block_q, block_kv, window))
+    kv_map = _kv_index_map(group, block_q, block_kv, window, Skv // block_kv)
 
     out, lse = pl.pallas_call(
         functools.partial(
-            _fwd_kernel, scale=scale, block_q=block_q, block_kv=block_kv, causal=causal
+            _fwd_kernel, scale=scale, block_q=block_q, block_kv=block_kv, causal=causal, window=window, skv=Skv
         ),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_kv, D), lambda b, h, i, j: (b, h // group, j, 0)),
-            pl.BlockSpec((1, 1, block_kv, D), lambda b, h, i, j: (b, h // group, j, 0)),
+            pl.BlockSpec((1, 1, block_kv, D), kv_map),
+            pl.BlockSpec((1, 1, block_kv, D), kv_map),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
@@ -128,7 +202,7 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_kv):
 # ---------------------------------------------------------------------------
 # Backward
 # ---------------------------------------------------------------------------
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr, *, scale, block_q, block_kv, causal):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr, *, scale, block_q, block_kv, causal, window, skv):
     j = pl.program_id(3)
     nj = pl.num_programs(3)
     i = pl.program_id(2)
@@ -138,8 +212,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
     q_start = i * block_q
-    kv_start = j * block_kv
-    needed = (not causal) or (kv_start <= q_start + block_q - 1)
+    jv = _kv_block_offset(i, block_q, block_kv, window) + j if window else j
+    kv_start = jv * block_kv
+    needed = _block_needed(
+        q_start, kv_start, block_q, block_kv, causal, window, skv
+    )
 
     @pl.when(needed)
     def _compute():
@@ -153,9 +230,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
         if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
-            k_pos = kv_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = _band_mask(s, q_start, kv_start, block_q, block_kv, window)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -170,7 +245,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s
         dq_ref[0, 0, :, :] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *, scale, block_q, block_kv, causal):
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *, scale, block_q, block_kv, causal, window, sq):
     i = pl.program_id(3)  # q blocks innermost here
     ni = pl.num_programs(3)
     j = pl.program_id(2)
@@ -180,9 +255,19 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    q_start = i * block_q
+    # Banded grid under a window: grid step i maps to q block offset+i
+    # (the same formula as the q-side BlockSpec index maps).
+    iv = _q_block_offset(j, block_q, block_kv) + i if window else i
+    q_start = iv * block_q
     kv_start = j * block_kv
+    # Like _block_needed, but the offset axis here is q: the overrun guard
+    # bounds q_start instead of kv_start.
     needed = (not causal) or (kv_start <= q_start + block_q - 1)
+    if window:
+        needed = jnp.logical_and(
+            needed, kv_start + block_kv - 1 >= q_start - window + 1
+        )
+        needed = jnp.logical_and(needed, q_start < sq)
 
     @pl.when(needed)
     def _compute():
@@ -196,9 +281,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
         if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
-            k_pos = kv_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = _band_mask(s, q_start, kv_start, block_q, block_kv, window)
         p = jnp.exp(s - lse)  # [bq, bkv] fp32
         p_lo = p.astype(do.dtype)
         dv_scr[:] += jax.lax.dot_general(
@@ -218,7 +301,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         dv_ref[0, 0, :, :] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd(scale, causal, block_q, block_kv, res, g, g_lse=None):
+def _bwd(scale, causal, block_q, block_kv, window, res, g, g_lse=None):
     q, k, v, out, lse_small = res
     do = g
     B, Sq, Hq, D = q.shape
@@ -241,10 +324,11 @@ def _bwd(scale, causal, block_q, block_kv, res, g, g_lse=None):
         delta = delta - g_lse.astype(jnp.float32)
     delta = jnp.broadcast_to(delta[..., None], (*delta.shape, LANES))
 
+    kv_map = _kv_index_map(group, block_q, block_kv, window, Skv // block_kv)
     common_specs = [
         pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
-        pl.BlockSpec((1, 1, block_kv, D), lambda b, h, i, j: (b, h // group, j, 0)),
-        pl.BlockSpec((1, 1, block_kv, D), lambda b, h, i, j: (b, h // group, j, 0)),
+        pl.BlockSpec((1, 1, block_kv, D), kv_map),
+        pl.BlockSpec((1, 1, block_kv, D), kv_map),
         pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
         pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, i, j: (b, h, i, 0)),
         pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, i, j: (b, h, i, 0)),
@@ -252,9 +336,9 @@ def _bwd(scale, causal, block_q, block_kv, res, g, g_lse=None):
 
     dq = pl.pallas_call(
         functools.partial(
-            _bwd_dq_kernel, scale=scale, block_q=block_q, block_kv=block_kv, causal=causal
+            _bwd_dq_kernel, scale=scale, block_q=block_q, block_kv=block_kv, causal=causal, window=window, skv=Skv
         ),
-        grid=(B, Hq, Sq // block_q, Skv // block_kv),
+        grid=(B, Hq, Sq // block_q, _n_kv_steps(Skv, block_q, block_kv, window)),
         in_specs=common_specs,
         out_specs=pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B, Hq, Sq, D), q.dtype),
@@ -262,20 +346,29 @@ def _bwd(scale, causal, block_q, block_kv, res, g, g_lse=None):
         interpret=_interpret(),
     )(qt, kt, vt, dot, lse, delta)
 
-    # dkv kernels iterate q blocks innermost; index maps swap (i, j) roles.
+    # dkv kernels iterate q blocks innermost; index maps swap (i, j) roles,
+    # and under a window the q axis carries the banded offset.
+    n_q = Sq // block_q
+    if window:
+        def q_map(b, h, j, i):
+            iv = _q_block_offset(j, block_q, block_kv) + i
+            return (b, h, jnp.minimum(iv, n_q - 1), 0)
+    else:
+        def q_map(b, h, j, i):
+            return (b, h, i, 0)
     dkv_specs = [
-        pl.BlockSpec((1, 1, block_q, D), lambda b, h, j, i: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, block_q, D), q_map),
         pl.BlockSpec((1, 1, block_kv, D), lambda b, h, j, i: (b, h // group, j, 0)),
         pl.BlockSpec((1, 1, block_kv, D), lambda b, h, j, i: (b, h // group, j, 0)),
-        pl.BlockSpec((1, 1, block_q, D), lambda b, h, j, i: (b, h, i, 0)),
-        pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, j, i: (b, h, i, 0)),
-        pl.BlockSpec((1, 1, block_q, LANES), lambda b, h, j, i: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, block_q, D), q_map),
+        pl.BlockSpec((1, 1, block_q, LANES), q_map),
+        pl.BlockSpec((1, 1, block_q, LANES), q_map),
     ]
     dk_h, dv_h = pl.pallas_call(
         functools.partial(
-            _bwd_dkv_kernel, scale=scale, block_q=block_q, block_kv=block_kv, causal=causal
+            _bwd_dkv_kernel, scale=scale, block_q=block_q, block_kv=block_kv, causal=causal, window=window, sq=Sq
         ),
-        grid=(B, Hq, Skv // block_kv, Sq // block_q),
+        grid=(B, Hq, Skv // block_kv, _n_q_steps(Sq, block_q, block_kv, window)),
         in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_kv, D), lambda b, h, j, i: (b, h, j, 0)),
@@ -306,14 +399,14 @@ def _bwd(scale, causal, block_q, block_kv, res, g, g_lse=None):
 # The plain flash_attention path is this same custom_vjp with the lse
 # output dropped (one implementation to keep in sync; a zero lse cotangent
 # costs one subtract in bwd, noise next to the kernels).
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_lse(q, k, v, scale, causal, block_q, block_kv):
-    out, lse = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q, block_kv=block_kv)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_lse(q, k, v, scale, causal, block_q, block_kv, window):
+    out, lse = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q, block_kv=block_kv, window=window)
     return out, lse[..., 0]
 
 
-def _flash_lse_fwd(q, k, v, scale, causal, block_q, block_kv):
-    out, lse = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q, block_kv=block_kv)
+def _flash_lse_fwd(q, k, v, scale, causal, block_q, block_kv, window):
+    out, lse = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q, block_kv=block_kv, window=window)
     # Save lse de-replicated: [B, Hq, Sq] fp32 (2MB-scale) instead of the
     # kernel's [B, Hq, Sq, 128] layout (256MB-scale at flagship shapes) —
     # the lane-padded buffer lives only inside this fwd call (r1 OOM fix).
@@ -329,9 +422,9 @@ def _flash_lse_fwd(q, k, v, scale, causal, block_q, block_kv):
     return (out_r, lse_r), (q, k, v, out_r, lse_r)
 
 
-def _flash_lse_bwd(scale, causal, block_q, block_kv, res, g):
+def _flash_lse_bwd(scale, causal, block_q, block_kv, window, res, g):
     g_out, g_lse = g
-    return _bwd(scale, causal, block_q, block_kv, res, g_out, g_lse=g_lse)
+    return _bwd(scale, causal, block_q, block_kv, window, res, g_out, g_lse=g_lse)
 
 
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
@@ -366,6 +459,7 @@ def flash_attention_with_lse(
     scale: Optional[float] = None,
     block_q: int = 512,
     block_kv: int = 512,
+    window: Optional[int] = None,
 ) -> tuple:
     """flash_attention that also returns per-row logsumexp [B, Hq, Sq].
 
@@ -390,7 +484,12 @@ def flash_attention_with_lse(
     )
     if scale is None:
         scale = 1.0 / (D**0.5)
-    return _flash_lse(q, k, v, scale, causal, block_q, block_kv)
+    if window is not None:
+        assert causal, "sliding window requires causal attention"
+        assert window > 0, f"window must be positive, got {window}"
+    return _flash_lse(
+        q, k, v, scale, causal, block_q, block_kv, int(window or 0)
+    )
 
 
 def flash_eligible(
@@ -418,6 +517,7 @@ def flash_attention(
     scale: Optional[float] = None,
     block_q: int = 512,
     block_kv: int = 512,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Flash attention over [B, S, H, D] tensors (differentiable).
 
@@ -428,5 +528,5 @@ def flash_attention(
     """
     return flash_attention_with_lse(
         q, k, v, causal=causal, scale=scale,
-        block_q=block_q, block_kv=block_kv,
+        block_q=block_q, block_kv=block_kv, window=window,
     )[0]

@@ -321,3 +321,37 @@ class TestRematPolicies:
         g = grads_for("save_attn")
         for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(g)):
             assert jnp.allclose(a, b, atol=1e-6)
+
+
+def test_attention_window_model_paths_agree():
+    """config.attention_window: the flash kernel's block-skip banding and
+    the XLA fallback's mask must implement the same window; a windowed
+    model must differ from full causal."""
+    import numpy as np
+
+    cfg = tiny_config(
+        use_flash_attention=True,
+        flash_block_q=128,
+        flash_block_kv=128,
+        seq_length=256,
+        num_heads=2,
+        num_kv_heads=1,
+        hidden_size=128,  # head_dim 64: flash_eligible
+        attention_window=64,
+        precision="fp32",  # sharp flash-vs-XLA comparison (bf16 noise
+        # at early positions otherwise dominates the 2e-2 tolerance)
+    )
+    ids = jax.random.randint(
+        jax.random.PRNGKey(0), (2, cfg.seq_length), 0, cfg.vocab_size
+    )
+    model = LuminaTransformer(cfg)
+    params = model.init({"params": jax.random.PRNGKey(0)}, ids)["params"]
+    flash_logits, _ = model.apply({"params": params}, ids)
+    xla_cfg = dataclasses.replace(cfg, use_flash_attention=False)
+    xla_logits, _ = LuminaTransformer(xla_cfg).apply({"params": params}, ids)
+    np.testing.assert_allclose(
+        np.asarray(flash_logits), np.asarray(xla_logits), atol=2e-2
+    )
+    full_cfg = dataclasses.replace(cfg, attention_window=None)
+    full_logits, _ = LuminaTransformer(full_cfg).apply({"params": params}, ids)
+    assert float(jnp.max(jnp.abs(flash_logits - full_logits))) > 1e-3

@@ -9,7 +9,7 @@ from luminaai_tpu.ops.flash_attention import flash_attention
 from luminaai_tpu.ops.fused import clip_by_global_norm, cross_entropy_loss, global_norm
 
 
-def ref_attention(q, k, v, causal=True):
+def ref_attention(q, k, v, causal=True, window=None):
     B, S, Hq, D = q.shape
     g = Hq // k.shape[2]
     kk = jnp.repeat(k, g, axis=2)
@@ -17,6 +17,11 @@ def ref_attention(q, k, v, causal=True):
     s = jnp.einsum("bqhd,bkhd->bhqk", q, kk) / jnp.sqrt(D)
     if causal:
         mask = jnp.tril(jnp.ones((S, S), bool))
+        if window is not None:
+            pos = jnp.arange(S)
+            mask = jnp.logical_and(
+                mask, pos[:, None] - pos[None, :] < window
+            )
         s = jnp.where(mask, s, -1e30)
     p = jax.nn.softmax(s, -1)
     return jnp.einsum("bhqk,bkhd->bqhd", p, vv)
@@ -54,6 +59,43 @@ class TestFlashAttention:
         out = flash_attention(q, k, v, causal=False, block_q=128, block_kv=128)
         ref = ref_attention(q, k, v, causal=False)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    @pytest.mark.parametrize("window", [64, 128, 200])
+    def test_sliding_window_fwd_and_bwd(self, window):
+        """Windowed attention: position i attends to [i-W+1, i] only.
+        Block-skip geometry differs per W vs the 128-blocks (sub-block,
+        exact-block, straddling) — all must match the masked reference,
+        grads included."""
+        B, S, Hq, Hkv, D = 1, 512, 2, 1, 128
+        ks = jax.random.split(jax.random.PRNGKey(5), 3)
+        q = jax.random.normal(ks[0], (B, S, Hq, D), jnp.float32)
+        k = jax.random.normal(ks[1], (B, S, Hkv, D), jnp.float32)
+        v = jax.random.normal(ks[2], (B, S, Hkv, D), jnp.float32)
+        out = flash_attention(
+            q, k, v, block_q=128, block_kv=128, window=window
+        )
+        ref = ref_attention(q, k, v, window=window)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+        f = lambda q, k, v: (
+            flash_attention(q, k, v, block_q=128, block_kv=128,
+                            window=window) ** 2
+        ).sum()
+        r = lambda q, k, v: (ref_attention(q, k, v, window=window) ** 2).sum()
+        gf = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(r, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+
+    def test_window_changes_result(self):
+        # Guard against the mask silently not applying: a tight window
+        # must differ from full causal.
+        B, S, H, D = 1, 256, 2, 64
+        ks = jax.random.split(jax.random.PRNGKey(6), 3)
+        q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.float32) for kk in ks)
+        full = flash_attention(q, k, v, block_q=128, block_kv=128)
+        win = flash_attention(q, k, v, block_q=128, block_kv=128, window=32)
+        assert float(jnp.max(jnp.abs(full - win))) > 1e-3
 
 
 class TestCrossEntropy:
@@ -160,3 +202,18 @@ class TestFusedLMHeadCE:
             np.testing.assert_allclose(
                 float(m_plain[key]), float(m_fused[key]), rtol=1e-5
             )
+
+
+def test_windowed_grid_is_banded():
+    """The windowed kernels must shrink the sliding grid axis (O(S·W) grid
+    steps + K/V DMA, not O(S²)) — the whole point of the banded index
+    maps. Pin the step-count math."""
+    from luminaai_tpu.ops.flash_attention import _n_kv_steps, _n_q_steps
+
+    # window 1024, blocks 512: band spans at most 4 kv blocks per q block.
+    assert _n_kv_steps(131072, 512, 512, 1024) == 4
+    assert _n_q_steps(131072, 512, 512, 1024) == 4
+    # windowless: full grid.
+    assert _n_kv_steps(131072, 512, 512, 0) == 256
+    # window >= seq: no shrink beyond the full grid.
+    assert _n_kv_steps(2048, 512, 512, 4096) == 4
