@@ -106,6 +106,16 @@ VARIANTS = {
         "remat_policy": "save_attn",
         "moe_dispatch": "gather",
     },
+    # Same, with a 1k sliding window: the banded flash grids should
+    # recover most of the O(S^2)->O(S*W) attention win at 8k context.
+    "long8k_win1k": {
+        "seq_length": 8192,
+        "batch_size": 4,
+        "micro_batch_size": None,
+        "remat_policy": "save_attn",
+        "moe_dispatch": "gather",
+        "attention_window": 1024,
+    },
     "b24_q8_gmm_attn": {
         "batch_size": 24,
         "micro_batch_size": None,
