@@ -223,3 +223,23 @@ def test_all_five_axes_together():
     )
     assert proc.returncode == 0, proc.stderr[-3000:]
     assert "OK loss=" in proc.stdout
+
+
+def test_windowed_attention_shards_and_matches():
+    """attention_window composes with dp/fsdp/tp meshes (the window only
+    touches attention internals, never the sharding layout): windowed
+    sharded loss equals windowed dp-only loss and differs from full
+    causal."""
+    losses = {}
+    for name, kw in {
+        "dp_win": dict(attention_window=16),
+        "fsdp_tp_win": dict(
+            fsdp_parallel_size=4, tensor_parallel_size=2, attention_window=16
+        ),
+        "dp_full": {},
+    }.items():
+        cfg = tiny_config(**kw)
+        _, metrics, _ = run_one_step(cfg)
+        losses[name] = float(metrics["loss"])
+    assert losses["dp_win"] == pytest.approx(losses["fsdp_tp_win"], abs=2e-2)
+    assert abs(losses["dp_win"] - losses["dp_full"]) > 1e-4
