@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Turn a jax.profiler xplane trace into the per-subsystem step breakdown
+used for the r3 MFU attack (BENCHMARKS.md "Flagship profile" table).
+
+Usage:
+    python scripts/profile_flagship.py [variant] [outdir]   # capture
+    python scripts/analyze_trace.py <outdir> [n_steps]      # analyze
+
+n_steps = how many steps the trace window covered (profile_flagship
+captures 3). Requires the xprof package (baked into the image); the
+conversion runs on CPU — no TPU needed to analyze a saved trace.
+"""
+import collections
+import glob
+import json
+import os
+import re
+import sys
+
+
+def classify(fw_name: str, category: str, source: str) -> str:
+    if "attention" in fw_name and "pallas_call" in fw_name:
+        return "attn_flash_kernels"
+    if "bch,vh->bcv" in fw_name or "fused.py" in source:
+        return "ce_loss"
+    if re.search(r"egch,ehf|egcf,efh|gmm", fw_name):
+        return "moe_expert_matmul"
+    if "/moe/" in fw_name:
+        return "moe_route_dispatch"
+    if "attention/" in fw_name or "qkv" in fw_name:
+        return "attn_proj_rope"
+    if category == "data formatting":
+        return "data_formatting"
+    if not fw_name.strip():
+        return "unattributed(optimizer+dispatch_bwd)"
+    return "other"
+
+
+def main() -> None:
+    outdir = sys.argv[1] if len(sys.argv) > 1 else "profiles/flagship"
+    n_steps = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+
+    paths = glob.glob(
+        os.path.join(outdir, "plugins/profile/*/*.xplane.pb")
+    )
+    if not paths:
+        sys.exit(f"no xplane.pb under {outdir}/plugins/profile/*/")
+
+    from xprof.convert import raw_to_tool_data as rtd
+
+    data, _ = rtd.xspace_to_tool_data(paths, "hlo_stats", {})
+    table = json.loads(data)
+    cols = [c["label"] for c in table["cols"]]
+    idx = {c: i for i, c in enumerate(cols)}
+    rows = [[c.get("v") for c in r["c"]] for r in table["rows"]]
+
+    groups = collections.Counter()
+    bound = collections.defaultdict(collections.Counter)
+    for r in rows:
+        t = r[idx["Total self time (us)"]] or 0.0
+        fw = r[idx["Framework op name"]] or ""
+        src = re.sub(r"<[^>]+>", "", r[idx["Source Info"]] or "")
+        g = classify(fw, r[idx["HLO op category"]], src)
+        groups[g] += t
+        bound[g][r[idx["Bound by"]] or "?"] += t
+
+    total = sum(groups.values())
+    print(f"{'subsystem':38s} {'ms/step':>9s} {'%':>6s}  dominant bound")
+    for g, t in groups.most_common():
+        dom = bound[g].most_common(1)[0][0]
+        print(
+            f"{g:38s} {t / n_steps / 1e3:9.2f} {100 * t / total:5.1f}%  {dom}"
+        )
+    print(f"{'TOTAL':38s} {total / n_steps / 1e3:9.2f}")
+
+    # Top individual ops — where to look next.
+    print("\nTop 10 ops by self time:")
+    rows.sort(key=lambda r: -(r[idx["Total self time (us)"]] or 0))
+    for r in rows[:10]:
+        t = (r[idx["Total self time (us)"]] or 0) / n_steps / 1e3
+        fw = (r[idx["Framework op name"]] or "")[-70:]
+        print(
+            f"{t:8.2f} ms/step {r[idx['HLO op category']][:18]:18s} "
+            f"{r[idx['Bound by']] or '?':8s} {fw}"
+        )
+
+
+if __name__ == "__main__":
+    main()
