@@ -39,6 +39,7 @@ from luminaai_tpu.parallel.mesh import use_mesh
 from luminaai_tpu.parallel.sharding import (
     TrainState,
     batch_spec,
+    is_host_offloaded,
     logical_axis_rules,
 )
 
@@ -237,6 +238,9 @@ def make_train_step(
     loss_fn = loss_fn or make_loss_fn(config, model)
     accum = config.gradient_accumulation_steps
     bspec = NamedSharding(mesh, batch_spec())
+    # Host-offloaded optimizer state (pinned_host memory kinds in the
+    # shardings): the update streams it through device memory in-jit.
+    offloaded = is_host_offloaded(state_shardings.opt_state)
 
     def train_step(state: TrainState, batch: Batch):
         step_rng, new_rng = jax.random.split(state.rng)
@@ -247,7 +251,9 @@ def make_train_step(
             grads, grad_norm = clip_by_global_norm(grads, config.grad_clip_norm)
         else:  # clipping off; still report the norm for monitoring
             grad_norm = global_norm(grads)
-        new_state = state.apply_gradients(grads, tx).replace(rng=new_rng)
+        new_state = state.apply_gradients(
+            grads, tx, host_offload=offloaded
+        ).replace(rng=new_rng)
         metrics["grad_norm"] = grad_norm
         if schedule is not None:
             metrics["learning_rate"] = schedule(state.step)

@@ -32,7 +32,11 @@ from luminaai_tpu.config import Config
 from luminaai_tpu.models.transformer import LuminaTransformer
 from luminaai_tpu.monitoring.logger import TrainingHealthMonitor
 from luminaai_tpu.parallel.mesh import build_mesh, describe_mesh, initialize_multihost
-from luminaai_tpu.parallel.sharding import batch_spec, init_sharded_state
+from luminaai_tpu.parallel.sharding import (
+    batch_spec,
+    init_opt_to_shardings,
+    init_sharded_state,
+)
 from luminaai_tpu.parallel.train_step import make_eval_step, make_train_step
 from luminaai_tpu.training.checkpoint import CheckpointManager
 from luminaai_tpu.training.optimizer import make_optimizer, make_schedule
@@ -228,9 +232,11 @@ class Trainer:
         self.tx = make_optimizer(cfg, self.total_steps, sched)
         self.shardings = state_shardings(cfg, self.model, self.tx, self.mesh)
         new_params = jax.device_put(new_params, self.shardings.params)
-        opt_state = jax.jit(
-            self.tx.init, out_shardings=self.shardings.opt_state
-        )(new_params)
+        # Routes around mixed-memory-kind jit outputs when the optimizer
+        # state is host-offloaded (sharding.py init_opt_to_shardings).
+        opt_state = init_opt_to_shardings(
+            self.tx, new_params, self.shardings.opt_state
+        )
         # tx.init resets optax's internal counts to 0; restore them to the
         # true step so the LR schedule does NOT silently replay warmup.
         step_now = int(self.state.step)

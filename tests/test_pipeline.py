@@ -108,6 +108,17 @@ class TestPipelineEquivalence:
         # MoE aux metrics survive the pipelined reduction
         assert "moe_aux_loss" in m2 and np.isfinite(float(m2["moe_aux_loss"]))
 
+    def test_windowed_attention_pp2_matches_pp1(self):
+        """attention_window inside the 1F1B manual region: the window is
+        an attention-internal mask, so pipelined loss must match the
+        non-pipelined windowed loss — and differ from full causal."""
+        kw = dict(attention_window=16)
+        losses1, _ = run_steps(pp_config(**kw))
+        losses2, _ = run_steps(pp_config(pipeline_parallel_size=2, **kw))
+        assert abs(losses1[0] - losses2[0]) < 5e-2, (losses1, losses2)
+        full, _ = run_steps(pp_config())
+        assert abs(losses1[0] - full[0]) > 1e-4
+
     def test_pp2_training_reduces_loss(self):
         losses, m = run_steps(
             pp_config(pipeline_parallel_size=2, learning_rate=1e-3),
