@@ -247,3 +247,33 @@ def test_big_preset_trace_time_is_depth_independent():
     )
     elapsed = time.time() - t0
     assert elapsed < 60, f"b30 grad trace took {elapsed:.0f}s"
+
+
+def test_scan_composes_with_window_and_gmm():
+    """The r3 engines must survive the scan re-layout: sliding-window
+    attention (static mask inside the scanned block) and gmm dispatch
+    (pallas call inside nn.scan) both produce scan==unrolled logits."""
+    for kw in (
+        dict(attention_window=8),
+        dict(moe_dispatch="gmm", seq_length=32),  # N=G*S*k=128 rows
+    ):
+        cfg_plain = make_cfg(scan_layers=False, moe_pattern="all",
+                             num_layers=4, **kw)
+        cfg_scan = make_cfg(scan_layers=True, moe_pattern="all",
+                            num_layers=4, **kw)
+        model_p = LuminaTransformer(cfg_plain)
+        model_s = LuminaTransformer(cfg_scan)
+        ids = jnp.asarray(
+            np.random.RandomState(0).randint(
+                1, 128, size=(2, cfg_plain.seq_length)
+            ),
+            jnp.int32,
+        )
+        params = unbox(model_p.init(jax.random.key(0), ids)["params"])
+        stacked = stack_params_for_scan(cfg_scan, params)
+        logits_p, _ = model_p.apply({"params": params}, ids)
+        logits_s, _ = model_s.apply({"params": stacked}, ids)
+        np.testing.assert_allclose(
+            np.asarray(logits_p), np.asarray(logits_s),
+            rtol=2e-5, atol=2e-5, err_msg=str(kw),
+        )
