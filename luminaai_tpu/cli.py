@@ -51,6 +51,8 @@ def _apply_overrides(cfg, args) -> None:
         ("tp", "tensor_parallel_size"),
         ("ep", "expert_parallel_size"),
         ("sp", "sequence_parallel_size"),
+        ("moe_dispatch", "moe_dispatch"),
+        ("attention_window", "attention_window"),
     ]:
         val = getattr(args, flag, None)
         if val is not None:
@@ -865,6 +867,17 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--experiment")
         sp.add_argument("--no-moe", action="store_true")
         sp.add_argument("--no-flash", action="store_true")
+        sp.add_argument(
+            "--moe-dispatch", dest="moe_dispatch",
+            choices=["sort", "gather", "einsum", "gmm"],
+            help="expert dispatch engine (docs/sparse_architectures.md; "
+                 "gmm = ragged grouped matmul, single-chip)",
+        )
+        sp.add_argument(
+            "--attention-window", dest="attention_window", type=int,
+            help="sliding-window attention: attend to the last N "
+                 "positions only (O(S*W) long-context attention)",
+        )
         sp.add_argument(
             "--auto-hardware", action="store_true",
             help="optimize parallelism for detected devices",
