@@ -17,10 +17,12 @@ BASELINE.json). Aux losses: Switch load-balance (f·P·E) and router z-loss.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import linen as nn
 
 from luminaai_tpu.config import Config
@@ -87,6 +89,68 @@ def _sort_routing(
         return slot, gate, dropped, counts
 
     return jax.vmap(per_group)(router_probs)
+
+
+def _slot_rows(buf_egch, slot, capacity):
+    """Gather [G,S,k,H] rows out of an expert-major [E,G,C,H] buffer by
+    flat slot id, with the dropped-pair sentinel handling: slot == E*C
+    clamps to an arbitrary row and `kept` annihilates it. Single source
+    of truth for the combine path AND _dispatch_gather's adjoint (the
+    same sentinel/clamp invariant must never drift between them).
+
+    Returns (rows [G,S,k,H], kept [G,S,k,1])."""
+    E = buf_egch.shape[0]
+    G = slot.shape[0]
+    sl = jnp.minimum(slot, E * capacity - 1)
+    rows = buf_egch[
+        sl // capacity, jnp.arange(G)[:, None, None], sl % capacity
+    ]
+    kept = (slot < E * capacity).astype(buf_egch.dtype)[..., None]
+    return rows, kept
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _dispatch_gather(x, inv_egc, slot, capacity):
+    """Expert-major dispatch gather with a GATHER-only adjoint.
+
+    Forward: expert_in[e,g,c] = x[g, inv_egc[e,g,c]] (masked where the
+    slot is unfilled). The plain advanced-indexing VJP would scatter-add
+    E·C rows of d_x per group (~50ms/step at flagship scale in the r3
+    trace); but the inv table is a bijection on kept slots, and token t's
+    kept slots are exactly slot[g,t,r] — so the adjoint is the SAME
+    clamped-index row gather the combine path uses: d_x[g,t] =
+    Σ_r kept·d_expert_in[slot[g,t,r]]. Zero H-wide scatters anywhere in
+    the MoE path.
+    """
+    out, _ = _dispatch_gather_fwd(x, inv_egc, slot, capacity)
+    return out
+
+
+def _dispatch_gather_fwd(x, inv_egc, slot, capacity):
+    G, S, H = x.shape
+    filled = (inv_egc < S)[..., None].astype(x.dtype)
+    out = (
+        x[jnp.arange(G)[None, :, None], jnp.minimum(inv_egc, S - 1)] * filled
+    )  # [E, G, C, H]
+    return out, slot
+
+
+def _dispatch_gather_bwd(capacity, res, g):
+    slot = res
+    rows, kept = _slot_rows(g, slot, capacity)
+    # x enters in the layer compute dtype (the fwd casts first), so the
+    # cotangent dtype already matches it.
+    d_x = jnp.sum(rows * kept, axis=2)  # [G, S, H]
+    # Integer index tables get symbolic-zero (float0) cotangents;
+    # inv_egc's shape [E, G, C] is g.shape[:3].
+    return (
+        d_x,
+        np.zeros(g.shape[:3], jax.dtypes.float0),
+        np.zeros(slot.shape, jax.dtypes.float0),
+    )
+
+
+_dispatch_gather.defvjp(_dispatch_gather_fwd, _dispatch_gather_bwd)
 
 
 def _top_k_routing(
@@ -261,14 +325,11 @@ class MoELayer(nn.Module):
                 inv_egc = inv.reshape(G, E, capacity).transpose(1, 0, 2)
                 # Unfilled slots (inv == S) gather an arbitrary row and are
                 # zeroed by the mask — avoids concatenating a zero row onto
-                # x (a whole-activation HBM copy per layer).
-                filled = (inv_egc < S)[..., None].astype(self.dtype)
-                expert_in = (
-                    x.astype(self.dtype)[
-                        jnp.arange(G)[None, :, None],
-                        jnp.minimum(inv_egc, S - 1),
-                    ]
-                    * filled
+                # x (a whole-activation HBM copy per layer). The custom
+                # VJP's adjoint is ALSO a row gather (via the slot table),
+                # so no H-wide scatter exists anywhere in this path.
+                expert_in = _dispatch_gather(
+                    x.astype(self.dtype), inv_egc, slot, capacity
                 )  # [E, G, C, H]
             else:
 
@@ -336,14 +397,9 @@ class MoELayer(nn.Module):
                 # the zero gate annihilates — no zero-row concatenate (a full
                 # [G, E*C, H] HBM copy per layer, ~57ms/step in the r3
                 # flagship trace). The gather indexes expert_out's [E, G, C]
-                # layout directly, so no expert-major→token-major activation
-                # transpose materializes either.
-                sl = jnp.minimum(slot, E * capacity - 1)  # [G, S, k]
-                y = expert_out[
-                    sl // capacity,
-                    jnp.arange(G)[:, None, None],
-                    sl % capacity,
-                ]  # [G, S, k, H]
+                # layout directly (shared _slot_rows), so no expert-major→
+                # token-major activation transpose materializes either.
+                y, _ = _slot_rows(expert_out, slot, capacity)
                 out = jnp.einsum("gskh,gsk->gsh", y, gate)
             else:
                 out = jnp.einsum("gsec,egch->gsh", combine_w, expert_out)

@@ -189,7 +189,11 @@ class TestDispatchModes:
             return jnp.sum(out**2)
 
         out, metrics = layer.apply(params, x)
-        grads = jax.grad(loss)(params, x)
+        # argnums=(0, 1): the INPUT gradient is the one place the gather
+        # path's hand-written _dispatch_gather adjoint executes — param
+        # grads inside a standalone layer never route through d_x, so a
+        # params-only comparison would leave it unpinned.
+        grads = jax.grad(loss, argnums=(0, 1))(params, x)
         return out, metrics, grads
 
     def test_modes_equivalent(self):
