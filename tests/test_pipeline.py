@@ -108,6 +108,18 @@ class TestPipelineEquivalence:
         # MoE aux metrics survive the pipelined reduction
         assert "moe_aux_loss" in m2 and np.isfinite(float(m2["moe_aux_loss"]))
 
+    def test_moe_gather_vjp_pp2_matches_pp1(self):
+        """Gather dispatch's custom-VJP adjoint inside the 1F1B manual
+        region (shard_map manual axes + custom_vjp is a combination worth
+        pinning explicitly)."""
+        kw = dict(
+            use_moe=True, num_experts=4, moe_pattern="all",
+            moe_dispatch="gather",
+        )
+        losses1, _ = run_steps(pp_config(**kw))
+        losses2, _ = run_steps(pp_config(pipeline_parallel_size=2, **kw))
+        assert abs(losses1[0] - losses2[0]) < 5e-2, (losses1, losses2)
+
     def test_windowed_attention_pp2_matches_pp1(self):
         """attention_window inside the 1F1B manual region: the window is
         an attention-internal mask, so pipelined loss must match the
