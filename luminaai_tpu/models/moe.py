@@ -152,6 +152,12 @@ def _dispatch_gather_bwd(capacity, res, g):
 
 _dispatch_gather.defvjp(_dispatch_gather_fwd, _dispatch_gather_bwd)
 
+# Test hook: inject a gmm implementation carrying the TPU kernel's
+# uninitialized-tail contract (rows past sum(group_sizes) undefined in out
+# AND grad_lhs) so _gmm_path's operand masking is pinned without a chip —
+# the CPU fallback below self-masks and cannot exercise it.
+_GMM_OVERRIDE = None
+
 
 def _top_k_routing(
     router_probs: jax.Array, top_k: int, capacity: int
@@ -464,7 +470,9 @@ class MoELayer(nn.Module):
             "shape"
         )
         on_tpu = jax.default_backend() == "tpu"
-        if on_tpu:
+        if _GMM_OVERRIDE is not None:
+            gmm = _GMM_OVERRIDE
+        elif on_tpu:
             from jax.experimental.pallas.ops.tpu.megablox import gmm
         else:
             # Megablox's interpret mode is minutes-per-call even at test
@@ -495,8 +503,20 @@ class MoELayer(nn.Module):
         perm = jnp.argsort(e_pair, stable=True)  # [N] pair ids, expert-major
         # Pair id p = ((g*S)+s)*k + r -> its token row in x_flat is p // k.
         x_flat = x.astype(self.dtype).reshape(G * S, H)
-        lhs = x_flat[perm // k]  # [N, H] expert-sorted token rows
         group_sizes = counts.sum(axis=0).astype(jnp.int32)  # [E] kept rows
+        # Rows past sum(group_sizes) are never touched by the kernel: its
+        # forward leaves those output tiles uninitialized, and its custom
+        # VJP leaves the matching grad_lhs rows uninitialized too (it only
+        # zeroes the tail when rhs carries more groups than group_sizes —
+        # not the case here). Dropped pairs still map via perm//k to REAL
+        # token rows, so uninitialized grad rows would scatter-add garbage
+        # into real tokens' d_x through the x_flat[perm//k] gather VJP.
+        # jnp.where on the OPERANDS fixes both directions: its VJP selects
+        # (rather than multiplies), so cotangents for masked rows are
+        # annihilated exactly, and NaN garbage cannot leak through.
+        total_kept = group_sizes.sum()
+        row_kept = jnp.arange(N)[:, None] < total_kept  # [N, 1]
+        lhs = jnp.where(row_kept, x_flat[perm // k], 0)  # [N, H] sorted rows
 
         fused = gmm(
             lhs,
@@ -505,18 +525,17 @@ class MoELayer(nn.Module):
             preferred_element_type=self.dtype,
         )  # [N, 2F]
         gate_act, up = jnp.split(fused, 2, axis=-1)
-        act = nn.silu(gate_act) * up
+        act = jnp.where(row_kept, nn.silu(gate_act) * up, 0)
         yrow = gmm(
             act,
             wo.astype(self.dtype),
             group_sizes,
             preferred_element_type=self.dtype,
         )  # [N, H]
-        # Rows past the kept region are never stored by the kernel
-        # (uninitialized output tiles) — zero them before the unsort so
-        # garbage can't meet a NaN-propagating gate product.
-        total_kept = group_sizes.sum()
-        yrow = jnp.where(jnp.arange(N)[:, None] < total_kept, yrow, 0.0)
+        # Forward output tiles past the kept region are uninitialized too —
+        # zero them before the unsort so garbage can't meet a
+        # NaN-propagating gate product.
+        yrow = jnp.where(row_kept, yrow, 0.0)
 
         inv_perm = jnp.argsort(perm)  # back to pair order
         y_pairs = yrow[inv_perm].reshape(G, S, k, H)

@@ -247,6 +247,162 @@ class TestDispatchModes:
         )(lhs)
         assert bool(jnp.isfinite(g).all())
 
+    def test_megablox_kernel_tail_rows_masked(self):
+        """sum(group_sizes) < m — the shape _gmm_path actually runs under
+        whenever any pair is dropped. The kernel's contract there is that
+        rows past the kept region are UNDEFINED in out and grad_lhs (its
+        custom VJP only zeroes the tail in the sharded-groups case), so
+        _gmm_path masks the operands with jnp.where. Pin that the masked
+        form gives (a) correct kept-region output, (b) exactly-zero
+        grad_lhs tail rows, and (c) grad_rhs with no tail contribution —
+        both vs a dense masked-matmul reference."""
+        from jax.experimental.pallas.ops.tpu.megablox import gmm
+
+        rng = np.random.RandomState(1)
+        m, h, f = 256, 64, 96
+        lhs = jnp.asarray(rng.randn(m, h), jnp.float32)
+        rhs = jnp.asarray(rng.randn(4, h, f), jnp.float32)
+        gs = jnp.array([100, 0, 60, 36], jnp.int32)  # sums to 196 < 256
+        kept = int(np.asarray(gs).sum())
+        row_kept = jnp.arange(m)[:, None] < kept
+
+        def masked_loss(gmm_fn, l, r):
+            out = gmm_fn(
+                jnp.where(row_kept, l, 0), r, gs,
+                preferred_element_type=jnp.float32,
+            )
+            return jnp.sum(jnp.where(row_kept, out, 0.0) ** 2)
+
+        def kernel(l, r, group_sizes, preferred_element_type):
+            return gmm(l, r, group_sizes,
+                       preferred_element_type=preferred_element_type,
+                       interpret=True)
+
+        def dense_ref(l, r, group_sizes, preferred_element_type):
+            bounds = jnp.cumsum(group_sizes)
+            row_e = jnp.searchsorted(bounds, jnp.arange(m), side="right")
+            out = jnp.zeros((m, f), preferred_element_type)
+            for e in range(4):
+                sel = (row_e == e)[:, None].astype(l.dtype)
+                out = out + (l * sel) @ r[e]
+            return out
+
+        out_k = kernel(jnp.where(row_kept, lhs, 0), rhs, gs, jnp.float32)
+        out_r = dense_ref(jnp.where(row_kept, lhs, 0), rhs, gs, jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(out_k)[:kept], np.asarray(out_r)[:kept],
+            atol=1e-4, rtol=1e-4,
+        )
+        gl_k, gr_k = jax.grad(
+            lambda l, r: masked_loss(kernel, l, r), argnums=(0, 1)
+        )(lhs, rhs)
+        gl_r, gr_r = jax.grad(
+            lambda l, r: masked_loss(dense_ref, l, r), argnums=(0, 1)
+        )(lhs, rhs)
+        # (b) the select-VJP annihilates tail cotangents exactly — any
+        # kernel garbage (NaN included) past the kept region must not leak.
+        assert np.all(np.asarray(gl_k)[kept:] == 0.0)
+        np.testing.assert_allclose(
+            np.asarray(gl_k), np.asarray(gl_r), atol=1e-4, rtol=1e-4
+        )
+        # (c) grad_rhs sees only kept rows (masked lhs rows are zero).
+        np.testing.assert_allclose(
+            np.asarray(gr_k), np.asarray(gr_r), atol=1e-4, rtol=1e-4
+        )
+
+    def test_gmm_path_masks_kernel_garbage(self, monkeypatch):
+        """Pin that _gmm_path ITSELF masks the kernel's uninitialized
+        tail (not just that masking-as-a-pattern works): inject a gmm
+        whose forward writes NaN into rows past sum(group_sizes) and
+        whose custom-VJP backward writes NaN into the same grad_lhs rows
+        — exactly the real megablox contract on TPU. With the operand
+        masks in place, layer output and input grads must stay finite
+        and match the sort path; without them, this test goes NaN."""
+        import dataclasses
+
+        from luminaai_tpu.models import moe as moe_mod
+
+        def nan_tail_gmm(lhs, rhs, group_sizes, preferred_element_type, **_):
+            m, n_e = lhs.shape[0], rhs.shape[0]
+
+            def dense(l, r, gsf):
+                gs = gsf.astype(jnp.int32)
+                bounds = jnp.cumsum(gs)
+                row_e = jnp.searchsorted(
+                    bounds, jnp.arange(m), side="right"
+                )
+                out = jnp.zeros((m, r.shape[-1]), preferred_element_type)
+                for e in range(n_e):
+                    sel = (row_e == e)[:, None].astype(l.dtype)
+                    out = out + ((l * sel) @ r[e]).astype(
+                        preferred_element_type
+                    )
+                return out
+
+            @jax.custom_vjp
+            def core(l, r, gsf):
+                kept = gsf.astype(jnp.int32).sum()
+                return jnp.where(
+                    jnp.arange(m)[:, None] < kept, dense(l, r, gsf), jnp.nan
+                )
+
+            def core_fwd(l, r, gsf):
+                return core(l, r, gsf), (l, r, gsf)
+
+            def core_bwd(res, ct):
+                l, r, gsf = res
+                kept = gsf.astype(jnp.int32).sum()
+                row_kept = jnp.arange(m)[:, None] < kept
+                # True cotangents for the kept region; grad_lhs tail rows
+                # are garbage in the real kernel — model that as NaN.
+                gl, gr = jax.vjp(
+                    lambda ll, rr: dense(ll, rr, gsf), l, r
+                )[1](jnp.where(row_kept, ct, 0.0))
+                gl = jnp.where(row_kept, gl, jnp.nan)
+                return gl, gr, jnp.zeros_like(gsf)
+
+            core.defvjp(core_fwd, core_bwd)
+            return core(lhs, rhs, group_sizes.astype(jnp.float32))
+
+        monkeypatch.setattr(moe_mod, "_GMM_OVERRIDE", nan_tail_gmm)
+        x = jax.random.normal(jax.random.PRNGKey(5), (2, 64, 64))
+        cfg = dataclasses.replace(
+            moe_config(routing_noise_std=0.0),
+            moe_dispatch="gmm",
+            capacity_factor=0.5,  # force drops: total_kept < N
+        )
+        layer = MoELayer(cfg, dtype=jnp.float32)
+        params = layer.init(jax.random.PRNGKey(0), x)
+
+        def loss(p, xx):
+            out, m = layer.apply(p, xx)
+            return jnp.sum(out**2), m
+
+        (val, metrics), (gp, gx) = jax.value_and_grad(
+            loss, argnums=(0, 1), has_aux=True
+        )(params, x)
+        assert float(metrics["moe_drop_rate"]) > 0.0  # tail is non-empty
+        assert bool(jnp.isfinite(val))
+        assert bool(jnp.isfinite(gx).all()), "NaN leaked into d_x"
+        for _, leaf in jax.tree_util.tree_leaves_with_path(gp):
+            assert bool(jnp.isfinite(leaf).all()), "NaN leaked into d_params"
+
+        # And the values must MATCH the sort path, not merely be finite.
+        monkeypatch.setattr(moe_mod, "_GMM_OVERRIDE", None)
+        cfg_sort = dataclasses.replace(cfg, moe_dispatch="sort")
+        layer_s = MoELayer(cfg_sort, dtype=jnp.float32)
+
+        def loss_s(p, xx):
+            out, m = layer_s.apply(p, xx)
+            return jnp.sum(out**2), m
+
+        (_, _), (gp_s, gx_s) = jax.value_and_grad(
+            loss_s, argnums=(0, 1), has_aux=True
+        )(params, x)
+        np.testing.assert_allclose(
+            np.asarray(gx), np.asarray(gx_s), atol=1e-4, rtol=1e-4
+        )
+
     def test_gmm_matches_sort_under_capacity_pressure(self):
         """gmm's ragged grouping must reproduce the exact per-group FIFO
         capacity drops of _sort_routing (dropped pairs sort to the
