@@ -72,7 +72,7 @@ def _run_main():
 def test_tpu_flow_headline_and_flagship_embed(monkeypatch, restore_bench):
     """TPU path: ref-matched headline, flagship riding in extras, dense
     sidecar written — the full r3 artifact shape."""
-    monkeypatch.setattr(bench, "_probe_backend", lambda *a, **k: "tpu")
+    monkeypatch.setattr(bench, "_probe_backend", lambda *a, **k: ("tpu", "backend_probe=tpu(attempts=1,waited=0s)"))
     calls = []
 
     def fake(name, timeout):
@@ -92,7 +92,7 @@ def test_tpu_flow_headline_and_flagship_embed(monkeypatch, restore_bench):
 def test_tpu_flow_survives_flagship_failure(monkeypatch, restore_bench):
     """A wedged flagship rung costs only the extras annotation — the
     measured headline must still print."""
-    monkeypatch.setattr(bench, "_probe_backend", lambda *a, **k: "tpu")
+    monkeypatch.setattr(bench, "_probe_backend", lambda *a, **k: ("tpu", "backend_probe=tpu(attempts=1,waited=0s)"))
 
     def fake(name, timeout):
         if name in ("flagship_tuned", "dense200"):
@@ -107,7 +107,7 @@ def test_tpu_flow_survives_flagship_failure(monkeypatch, restore_bench):
 
 def test_headline_falls_back_down_the_ladder(monkeypatch, restore_bench):
     """ref_debug_moe failing falls through to flagship_tuned as headline."""
-    monkeypatch.setattr(bench, "_probe_backend", lambda *a, **k: "tpu")
+    monkeypatch.setattr(bench, "_probe_backend", lambda *a, **k: ("tpu", "backend_probe=tpu(attempts=1,waited=0s)"))
 
     def fake(name, timeout):
         if name == "ref_debug_moe":
@@ -121,7 +121,7 @@ def test_headline_falls_back_down_the_ladder(monkeypatch, restore_bench):
 
 def test_probe_failure_goes_straight_to_cpu_fallback(monkeypatch):
     """No TPU: only the cpu_fallback rung runs, annotated as such."""
-    monkeypatch.setattr(bench, "_probe_backend", lambda *a, **k: None)
+    monkeypatch.setattr(bench, "_probe_backend", lambda *a, **k: (None, "backend_probe=failed(attempts=5,waited=1500s,budget=1500s)"))
     calls = []
 
     def fake(name, timeout):
@@ -139,10 +139,190 @@ def test_probe_failure_goes_straight_to_cpu_fallback(monkeypatch):
 
 
 def test_every_rung_failing_still_emits_one_line(monkeypatch):
-    monkeypatch.setattr(bench, "_probe_backend", lambda *a, **k: "tpu")
+    monkeypatch.setattr(bench, "_probe_backend", lambda *a, **k: ("tpu", "backend_probe=tpu(attempts=1,waited=0s)"))
     monkeypatch.setattr(
         bench, "_run_child", lambda n, t: (None, f"{n}: dead")
     )
     out = _run_main()
     assert out["value"] == 0.0
     assert "error" in out
+
+
+class _FakeClock:
+    """Deterministic monotonic clock; sleep() advances it."""
+
+    def __init__(self):
+        self.now = 0.0
+        self.sleeps = []
+
+    def monotonic(self):
+        return self.now
+
+    def sleep(self, s):
+        self.sleeps.append(s)
+        self.now += s
+
+
+def _patch_probe_env(monkeypatch, run_impl, clock):
+    import subprocess as sp
+
+    class FakeTime:
+        monotonic = staticmethod(clock.monotonic)
+        sleep = staticmethod(clock.sleep)
+        perf_counter = staticmethod(clock.monotonic)
+
+    monkeypatch.setattr(bench, "time", FakeTime)
+
+    class FakeSubprocess:
+        TimeoutExpired = sp.TimeoutExpired
+        run = staticmethod(run_impl)
+
+    monkeypatch.setattr(bench, "subprocess", FakeSubprocess)
+
+
+def test_probe_waits_out_a_tunnel_outage(monkeypatch):
+    """Hung probes (the dead-tunnel signature) are retried on a cadence
+    until the tunnel answers — the r1/r3 failure mode where one dead
+    probe surrendered the whole round to a CPU artifact."""
+    import subprocess as sp
+
+    clock = _FakeClock()
+    attempts = []
+
+    def run_impl(cmd, timeout=None, **k):
+        attempts.append(clock.now)
+        if len(attempts) < 4:
+            clock.now += timeout  # the probe hangs for its full timeout
+            raise sp.TimeoutExpired(cmd, timeout)
+
+        class P:
+            returncode = 0
+            stdout = "1 tpu"
+            stderr = ""
+
+        clock.now += 5
+        return P()
+
+    _patch_probe_env(monkeypatch, run_impl, clock)
+    platform, diag = bench._probe_backend()
+    assert platform == "tpu"
+    assert len(attempts) == 4
+    assert "attempts=4" in diag
+    assert clock.sleeps == [60, 60, 60]
+
+
+def test_probe_answering_cpu_returns_immediately(monkeypatch):
+    """A probe that ANSWERS with a non-tpu platform means no TPU is
+    configured — no point burning the wait budget."""
+    clock = _FakeClock()
+
+    def run_impl(cmd, timeout=None, **k):
+        class P:
+            returncode = 0
+            stdout = "8 cpu"
+            stderr = ""
+
+        return P()
+
+    _patch_probe_env(monkeypatch, run_impl, clock)
+    platform, diag = bench._probe_backend()
+    assert platform == "cpu"
+    assert clock.sleeps == []
+
+
+def test_probe_surrenders_after_budget(monkeypatch):
+    import subprocess as sp
+
+    clock = _FakeClock()
+    attempts = []
+
+    def run_impl(cmd, timeout=None, **k):
+        attempts.append(clock.now)
+        clock.now += timeout
+        raise sp.TimeoutExpired(cmd, timeout)
+
+    _patch_probe_env(monkeypatch, run_impl, clock)
+    platform, diag = bench._probe_backend(budget_s=600)
+    assert platform is None
+    assert "failed" in diag
+    # Bounded: every attempt started before the budget elapsed, and the
+    # loop stopped within one probe+sleep cycle of the deadline.
+    assert all(t < 600 for t in attempts)
+    assert clock.now <= 600 + 90 + 60
+
+
+def test_probe_crash_loop_surrenders_early_with_stderr(monkeypatch):
+    """Fast deterministic probe crashes (answering by dying, not hanging)
+    get a ~5-minute sub-budget, and the last stderr line reaches the
+    diag so the artifact can distinguish config error from outage."""
+    clock = _FakeClock()
+    attempts = []
+
+    def run_impl(cmd, timeout=None, **k):
+        attempts.append(clock.now)
+
+        class P:
+            returncode = 1
+            stdout = ""
+            stderr = "RuntimeError: Unable to initialize backend 'tpu'\n"
+
+        clock.now += 3  # fast crash
+        return P()
+
+    _patch_probe_env(monkeypatch, run_impl, clock)
+    platform, diag = bench._probe_backend(budget_s=1500)
+    assert platform is None
+    assert "Unable to initialize backend" in diag
+    assert clock.now <= 300 + 90 + 60  # early surrender, not 1500s
+    assert len(attempts) < 8
+
+
+def test_probe_hang_restores_full_budget_after_crashes(monkeypatch):
+    """A crash-loop that then hangs is tunnel-shaped: the full budget
+    applies and a late recovery is still caught."""
+    import subprocess as sp
+
+    clock = _FakeClock()
+    attempts = []
+
+    def run_impl(cmd, timeout=None, **k):
+        attempts.append(clock.now)
+        if len(attempts) <= 2:
+            class P:
+                returncode = 1
+                stdout = ""
+                stderr = "exit 1\n"
+
+            clock.now += 3
+            return P()
+        if clock.now < 700:
+            clock.now += timeout
+            raise sp.TimeoutExpired(cmd, timeout)
+
+        class P:
+            returncode = 0
+            stdout = "1 tpu"
+            stderr = ""
+
+        return P()
+
+    _patch_probe_env(monkeypatch, run_impl, clock)
+    platform, diag = bench._probe_backend(budget_s=1500)
+    assert platform == "tpu"
+
+
+def test_probe_malformed_env_budget_defaults(monkeypatch):
+    clock = _FakeClock()
+
+    def run_impl(cmd, timeout=None, **k):
+        class P:
+            returncode = 0
+            stdout = "1 tpu"
+            stderr = ""
+
+        return P()
+
+    _patch_probe_env(monkeypatch, run_impl, clock)
+    monkeypatch.setenv("BENCH_PROBE_BUDGET_S", "25min")
+    platform, _ = bench._probe_backend()
+    assert platform == "tpu"
