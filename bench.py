@@ -319,6 +319,8 @@ def _probe_backend(timeout: int = 90, budget_s: float | None = None):
             budget_s = float(os.environ.get("BENCH_PROBE_BUDGET_S", "1500"))
         except ValueError:
             budget_s = 1500.0  # malformed env must not cost the artifact
+        if not (0 <= budget_s < 86_400):  # nan/inf/negative: same rule
+            budget_s = 1500.0
     code = (
         "import jax, jax.numpy as jnp; "
         "x = jnp.ones((256, 256), jnp.bfloat16); "
