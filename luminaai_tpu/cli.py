@@ -142,12 +142,30 @@ def make_data(cfg, args):
             path, str(Path(cfg.output_dir) / "cache" / Path(path).stem),
             tokenizer,
         )
+        # Per-host shard identity comes from config, not live jax state
+        # (the distributed runtime comes up later, in Trainer.__init__).
+        # On pods where jax auto-detects the process id, process_id is
+        # legitimately None — sharding on it would put EVERY host on
+        # shard 0, so fall back to the process-oblivious full-batch
+        # loader (Trainer._put slices each host's rows at runtime).
+        pi, pc = 0, 1
+        if cfg.multihost and (cfg.num_processes or 1) > 1:
+            if cfg.process_id is not None:
+                pi, pc = cfg.process_id, cfg.num_processes
+            else:
+                logger.warning(
+                    "multihost without explicit process_id: data sharding "
+                    "disabled; every host will read the full corpus (set "
+                    "config.process_id to enable per-host shards)"
+                )
         ds = PackedDataset(
             cache, cfg.batch_size, cfg.seq_length,
             pad_id=tokenizer.pad_token_id, eos_id=tokenizer.eos_token_id,
             shuffle_seed=cfg.seed,
             use_native=cfg.use_native_dataloader,
             split_docs=cfg.pack_sequences,
+            process_index=pi,
+            process_count=pc,
         )
         return (
             PrefetchLoader(lambda: iter(ds), prefetch=max(1, cfg.num_workers)),

@@ -275,8 +275,21 @@ class ConversationDataset:
 # Packed dataset (base training over a TokenCache)
 # ---------------------------------------------------------------------------
 class PackedDataset:
-    """Contiguous packed [B, S] batches from a TokenCache via the native
-    packer (ref FastBaseTrainingDataset chunking, :118)."""
+    """Contiguous packed batches from a TokenCache via the native packer
+    (ref FastBaseTrainingDataset chunking, :118).
+
+    Multi-host: pass `process_index`/`process_count` and each host reads
+    ONLY its own document shard (strided over the shared doc order) and
+    yields LOCAL [batch_size/process_count, S] batches — the trainer
+    assembles the global array via make_array_from_process_local_data.
+    This replaces the reference's rank-keyed DistributedSampler plumbing
+    (ref backend_fsdp.py:116 world_size/rank) with the JAX-native
+    per-process input pattern: no host ever materializes (or even reads)
+    another host's rows. Hosts stay in lockstep via a metadata-only
+    batch-count cap computed identically on every host; a host whose
+    shard packs short wraps around its own shard rather than desyncing
+    the collective.
+    """
 
     def __init__(
         self,
@@ -288,9 +301,20 @@ class PackedDataset:
         shuffle_seed: Optional[int] = None,
         use_native: bool = True,
         split_docs: bool = True,
+        process_index: int = 0,
+        process_count: int = 1,
     ):
         if cache.tokens is None:
             cache.open()
+        if not 0 <= process_index < process_count:
+            raise ValueError(
+                f"process_index {process_index} not in [0, {process_count})"
+            )
+        if batch_size % process_count != 0:
+            raise ValueError(
+                f"global batch {batch_size} not divisible by "
+                f"process_count {process_count}"
+            )
         self.cache = cache
         self.batch_size = batch_size
         self.seq_length = seq_length
@@ -301,53 +325,111 @@ class PackedDataset:
         # pack_sequences=False semantics: a document never straddles rows
         # (truncate-to-row instead of contiguous-stream packing).
         self.split_docs = split_docs
+        self.process_index = process_index
+        self.process_count = process_count
+        self.local_batch = batch_size // process_count
 
     def batches_per_epoch(self) -> int:
         per_batch = self.batch_size * self.seq_length
         return max(1, self.cache.n_tokens // per_batch)
 
-    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+    def _global_order(self) -> np.ndarray:
+        """The one doc order every host derives identically (shared seed),
+        so the per-host strides below are disjoint + exhaustive."""
+        n = self.cache.n_docs
         if self.shuffle_seed is not None:
-            yield from self._iter_shuffled()
+            return np.asarray(shuffle_indices(n, self.shuffle_seed))
+        return np.arange(n)
+
+    def _doc_order(self, host: int, wrap: int = 0) -> np.ndarray:
+        """Doc ids host `host` walks this epoch (its stride of the global
+        order). `wrap` permutes the host's OWN shard for a re-walk after
+        an early pack-out — never a different global order, so a wrapped
+        host still reads only its shard, and the re-walk isn't a
+        byte-identical replay."""
+        shard = self._global_order()[host::self.process_count]
+        if wrap and len(shard) > 1:
+            perm = np.asarray(shuffle_indices(
+                len(shard), (self.shuffle_seed or 0) + 7919 * wrap
+            ))
+            shard = shard[perm]
+        return shard
+
+    def _lockstep_batches(self) -> int:
+        """Per-epoch batch count every host agrees on, from metadata only:
+        min over hosts of (shard tokens // local batch tokens). Computed
+        identically everywhere (shared offsets table + shared seed), so
+        no communication is needed to stay in lockstep."""
+        doclens = np.diff(self.cache.offsets)
+        order = self._global_order()
+        per_batch = self.local_batch * self.seq_length
+        return min(
+            int(doclens[order[q::self.process_count]].sum()) // per_batch
+            for q in range(self.process_count)
+        )
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        if self.process_count == 1 and self.shuffle_seed is None:
+            # Fast path: sequential cursor straight over the memmap, no
+            # per-doc copies.
+            offsets = self.cache.offsets
+            tokens = self.cache.tokens
+            doc, tok = 0, 0
+            n_docs = len(offsets) - 1
+            while doc < n_docs:
+                out, mask, doc, tok = pack_batch(
+                    tokens, offsets, doc,
+                    self.batch_size, self.seq_length,
+                    pad_id=self.pad_id, eos_id=self.eos_id,
+                    split_docs=self.split_docs, start_token=tok,
+                    use_native=self.use_native,
+                )
+                if mask.sum() == 0:
+                    break
+                yield {
+                    "input_ids": out,
+                    "loss_mask": mask.astype(np.float32),
+                }
             return
+        if self.process_count == 1:
+            yield from self._iter_docs(self._doc_order(0), self.batch_size)
+            return
+        # Multi-host: fixed agreed batch count; wrap own shard if it packs
+        # short (possible in truncate mode, where row-boundary waste makes
+        # the metadata estimate an upper bound).
+        cap = self._lockstep_batches()
+        count = 0
+        wrap = 0
+        while count < cap:
+            produced = False
+            order = self._doc_order(self.process_index, wrap)
+            for b in self._iter_docs(order, self.local_batch):
+                produced = True
+                yield b
+                count += 1
+                if count >= cap:
+                    return
+            wrap += 1
+            if not produced:
+                return  # empty shard: cap was 0 anyway
+
+    def _iter_docs(
+        self, order: np.ndarray, rows: int
+    ) -> Iterator[Dict[str, np.ndarray]]:
+        """Walk `order`'s docs through a sliding window of per-doc slices
+        copied from the memmap — never materializing the corpus (the old
+        gather-everything path OOM'd on multi-GB caches). The window holds
+        just enough docs for one batch plus the carry of a split doc, so
+        peak memory is O(rows·seq + longest doc)."""
         offsets = self.cache.offsets
         tokens = self.cache.tokens
-        doc, tok = 0, 0
-        n_docs = len(offsets) - 1
-        while doc < n_docs:
-            out, mask, doc, tok = pack_batch(
-                tokens, offsets, doc,
-                self.batch_size, self.seq_length,
-                pad_id=self.pad_id, eos_id=self.eos_id,
-                split_docs=self.split_docs, start_token=tok,
-                use_native=self.use_native,
-            )
-            if mask.sum() == 0:
-                break
-            yield {
-                "input_ids": out,
-                "loss_mask": mask.astype(np.float32),
-            }
-
-    def _iter_shuffled(self) -> Iterator[Dict[str, np.ndarray]]:
-        """Document-shuffled epoch with bounded host memory.
-
-        Walks the permuted doc order through a sliding window of per-doc
-        slices copied from the memmap — never materializing the corpus
-        (the old gather-everything path OOM'd on multi-GB caches). The
-        window holds just enough docs for one full batch plus the carry
-        of a split doc, so peak memory is O(batch·seq + longest doc).
-        """
-        offsets = self.cache.offsets
-        tokens = self.cache.tokens
-        perm = shuffle_indices(self.cache.n_docs, self.shuffle_seed)
-        need = self.batch_size * (self.seq_length + 1)
+        need = rows * (self.seq_length + 1)
         buf_docs: List[np.ndarray] = []
         buf_tokens = 0
         pi = 0
         while True:
-            while buf_tokens < need and pi < len(perm):
-                d = int(perm[pi])
+            while buf_tokens < need and pi < len(order):
+                d = int(order[pi])
                 pi += 1
                 arr = np.asarray(tokens[offsets[d]:offsets[d + 1]])
                 if arr.size:
@@ -363,7 +445,7 @@ class PackedDataset:
             ).astype(np.int64)
             out, mask, next_doc, next_tok = pack_batch(
                 cat, local_offsets, 0,
-                self.batch_size, self.seq_length,
+                rows, self.seq_length,
                 pad_id=self.pad_id, eos_id=self.eos_id,
                 split_docs=self.split_docs, start_token=0,
                 use_native=self.use_native,
@@ -384,7 +466,7 @@ class PackedDataset:
                 rest.extend(buf_docs[next_doc + 1:])
             buf_docs = rest
             buf_tokens = sum(a.size for a in buf_docs)
-            if not buf_docs and pi >= len(perm):
+            if not buf_docs and pi >= len(order):
                 break
 
 

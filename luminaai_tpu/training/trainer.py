@@ -45,6 +45,50 @@ from luminaai_tpu.training.precision import PrecisionManager
 logger = logging.getLogger(__name__)
 
 
+def put_process_local_batch(
+    batch: Dict[str, np.ndarray],
+    batch_sharding: NamedSharding,
+    global_batch_size: int,
+) -> Dict[str, jax.Array]:
+    """Multi-host input assembly: each host contributes ONLY its local
+    rows; make_array_from_process_local_data builds the global [batch,...]
+    array across processes (no host materializes or transfers another
+    host's shard — the JAX-native form of the ref's rank-keyed
+    DistributedSampler, backend_fsdp.py:116). Module-level so the
+    multihost test drives the exact production path without a Trainer.
+
+    Accepts either per-host-shard rows (global/process_count) or, from a
+    process-oblivious loader, the full global batch — then this host's
+    rows are sliced out so the device layout matches the sharded-loader
+    path exactly.
+    """
+    pc = jax.process_count()
+    if global_batch_size % pc != 0:
+        raise ValueError(
+            f"global batch {global_batch_size} not divisible by "
+            f"process_count {pc}: trailing rows would silently drop"
+        )
+    local = global_batch_size // pc
+    out: Dict[str, jax.Array] = {}
+    for k, v in batch.items():
+        v = np.asarray(v)
+        if v.shape[0] == global_batch_size and local != global_batch_size:
+            pi = jax.process_index()
+            v = v[pi * local:(pi + 1) * local]
+        elif v.shape[0] != local:
+            raise ValueError(
+                f"batch '{k}' rows {v.shape[0]} is neither the global "
+                f"batch ({global_batch_size}) nor the per-host shard "
+                f"({local})"
+            )
+        out[k] = jax.make_array_from_process_local_data(
+            batch_sharding,
+            np.ascontiguousarray(v),
+            global_shape=(v.shape[0] * pc,) + v.shape[1:],
+        )
+    return out
+
+
 class Trainer:
     """End-to-end trainer: mesh + sharded state + loop + eval + checkpoints.
 
@@ -594,6 +638,10 @@ class Trainer:
 
     # -- data -------------------------------------------------------------
     def _put(self, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
+        if jax.process_count() > 1:
+            return put_process_local_batch(
+                batch, self._batch_sharding, self.config.batch_size
+            )
         return {
             k: jax.device_put(jnp.asarray(v), self._batch_sharding)
             for k, v in batch.items()

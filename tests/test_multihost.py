@@ -66,20 +66,49 @@ WORKER = textwrap.dedent("""
     )
     step = make_train_step(cfg, model, shardings, mesh, schedule, tx)
 
-    # Each process feeds its LOCAL shard of the global batch via
-    # make_array_from_process_local_data (the multi-host input pattern).
     from jax.sharding import NamedSharding
     from luminaai_tpu.parallel.sharding import batch_spec
 
-    global_ids = np.random.RandomState(0).randint(
-        1, cfg.vocab_size, size=(cfg.batch_size, cfg.seq_length)
-    ).astype(np.int32)
     bsharding = NamedSharding(mesh, batch_spec())
-    batch = {
-        "input_ids": jax.make_array_from_process_local_data(
-            bsharding, global_ids  # full array given; jax slices per process
+    if mode == "data":
+        # Production multi-host input path: this host's PackedDataset
+        # shard (docs pid::2 of the shared cache — nothing else is read)
+        # -> put_process_local_batch assembly -> sharded train step.
+        from luminaai_tpu.data.dataset import PackedDataset, TokenCache
+        from luminaai_tpu.training.trainer import put_process_local_batch
+
+        cache = TokenCache(sys.argv[4]).open()
+        ds = PackedDataset(
+            cache, cfg.batch_size, cfg.seq_length, pad_id=0,
+            process_index=pid, process_count=2,
         )
-    }
+        local = next(iter(ds))
+        assert local["input_ids"].shape == (
+            cfg.batch_size // 2, cfg.seq_length
+        ), local["input_ids"].shape
+        # Reads only its shard: every real token comes from docs pid::2.
+        shard_tokens = set()
+        for d in range(pid, cache.n_docs, 2):
+            shard_tokens |= set(
+                np.asarray(
+                    cache.tokens[cache.offsets[d]:cache.offsets[d+1]]
+                ).tolist()
+            )
+        real = local["input_ids"][local["loss_mask"] > 0]
+        assert set(real.tolist()) <= shard_tokens, "host read foreign docs"
+        batch = put_process_local_batch(local, bsharding, cfg.batch_size)
+    else:
+        # Each process feeds its LOCAL shard of the global batch via
+        # make_array_from_process_local_data (the multi-host input
+        # pattern).
+        global_ids = np.random.RandomState(0).randint(
+            1, cfg.vocab_size, size=(cfg.batch_size, cfg.seq_length)
+        ).astype(np.int32)
+        batch = {
+            "input_ids": jax.make_array_from_process_local_data(
+                bsharding, global_ids  # full array; jax slices per process
+            )
+        }
     state, metrics = step(state, batch)
     loss = float(metrics["loss"])
     assert np.isfinite(loss), loss
@@ -95,14 +124,30 @@ def _free_port() -> int:
     return port
 
 
-@pytest.mark.parametrize("mode", ["fsdp", "pipe"])
+def _build_cache(tmp_path):
+    """Shared token cache the 'data'-mode workers shard between them."""
+    from luminaai_tpu.data.dataset import TokenCache
+
+    rng = __import__("numpy").random.RandomState(7)
+    docs = [
+        rng.randint(1, 128, size=rng.randint(10, 60)).tolist()
+        for _ in range(40)
+    ]
+    stem = str(tmp_path / "mhcache")
+    TokenCache(stem).build(iter(docs))
+    return stem
+
+
+@pytest.mark.parametrize("mode", ["fsdp", "pipe", "data"])
 def test_two_process_distributed_train_step(tmp_path, mode):
     coordinator = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
+    argv_tail = [_build_cache(tmp_path)] if mode == "data" else []
     procs = [
         subprocess.Popen(
-            [sys.executable, "-c", WORKER, coordinator, str(pid), mode],
+            [sys.executable, "-c", WORKER, coordinator, str(pid), mode]
+            + argv_tail,
             env=env,
             cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
             stdout=subprocess.PIPE,
@@ -129,3 +174,52 @@ def test_two_process_distributed_train_step(tmp_path, mode):
     assert len(losses) == 2
     # Replicated loss scalar: both processes computed the same global value.
     assert abs(losses[0] - losses[1]) < 1e-6, losses
+
+    if mode == "data":
+        # Training-loss parity vs single-process: assemble the same global
+        # batch (concat of the two host shards) in THIS process and run
+        # the identical step on the local 8-device mesh.
+        import jax
+        import numpy as np
+
+        from jax.sharding import NamedSharding
+        from luminaai_tpu.config import Config
+        from luminaai_tpu.data.dataset import PackedDataset, TokenCache
+        from luminaai_tpu.models.transformer import LuminaTransformer
+        from luminaai_tpu.parallel.mesh import build_mesh
+        from luminaai_tpu.parallel.sharding import batch_spec, init_sharded_state
+        from luminaai_tpu.parallel.train_step import make_train_step
+        from luminaai_tpu.training.optimizer import make_optimizer, make_schedule
+
+        cfg = Config(
+            vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+            num_kv_heads=1, seq_length=32, batch_size=8,
+            use_flash_attention=False, gradient_checkpointing=False,
+            precision="fp32", fsdp_parallel_size=2,
+        )
+        cache = TokenCache(argv_tail[0]).open()
+        shards = [
+            next(iter(PackedDataset(
+                cache, cfg.batch_size, cfg.seq_length, pad_id=0,
+                process_index=q, process_count=2,
+            )))
+            for q in range(2)
+        ]
+        batch_np = {
+            k: np.concatenate([s[k] for s in shards]) for k in shards[0]
+        }
+        model = LuminaTransformer(cfg)
+        schedule = make_schedule(cfg, 10)
+        tx = make_optimizer(cfg, 10, schedule)
+        mesh = build_mesh(cfg)
+        state, shardings = init_sharded_state(
+            cfg, model, tx, mesh, jax.random.key(0)
+        )
+        step = make_train_step(cfg, model, shardings, mesh, schedule, tx)
+        bsharding = NamedSharding(mesh, batch_spec())
+        batch = {
+            k: jax.device_put(v, bsharding) for k, v in batch_np.items()
+        }
+        _, metrics = step(state, batch)
+        ref_loss = float(metrics["loss"])
+        assert abs(losses[0] - ref_loss) < 1e-4, (losses, ref_loss)
