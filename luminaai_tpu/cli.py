@@ -312,12 +312,20 @@ def cmd_chat(args) -> int:
     )
     if chat.engine.quantization_info:
         q = chat.engine.quantization_info
-        print(
-            f"serving with int{q['bits']} weight round-trip: "
-            f"{q['quantized_leaves']} tensors, {q['compression']:.2f}x "
-            "smaller at rest (resident serving copy stays bf16 for MXU "
-            "compute)", file=sys.stderr,
-        )
+        if q.get("mode") == "int8_compute":
+            print(
+                f"serving with int8 COMPUTE quantization: "
+                f"{q['quantized_leaves']} tensors run int8 MXU dots "
+                f"(W8A8), {q['compression']:.2f}x smaller resident",
+                file=sys.stderr,
+            )
+        else:
+            print(
+                f"serving with int{q['bits']} weight round-trip: "
+                f"{q['quantized_leaves']} tensors, {q['compression']:.2f}x "
+                "smaller at rest (resident serving copy stays bf16 for MXU "
+                "compute)", file=sys.stderr,
+            )
     # Generation defaults live on the engine's config (ref Chat.py mode
     # presets); CLI flags override them for the session.
     chat.engine.config.temperature = args.temperature

@@ -112,16 +112,18 @@ class GenerationEngine:
         self.tokenizer = tokenizer
         self.config = config or model.config
         self.max_context = max_context or self.config.seq_length
-        # Weight-only inference quantization (config.quantization_method =
-        # 'int8'/'int4'; ref trainer.py:575): weights round-trip through int
-        # codes here — compute stays bf16 on the MXU (the bnb trade).
+        # Inference quantization (config.quantization_method = 'int8'/
+        # 'int4'; ref trainer.py:575). int8 keeps QuantizedTensor leaves in
+        # the param tree — the model's quantization-aware layers run real
+        # int8 MXU dots (ops/quantized.py), the TPU counterpart of the
+        # ref's kernel-swapping quantization. int4 is storage-only
+        # (dequantized to bf16 here; packed nibbles have no MXU dtype).
         self.quantization_info: dict = {}
         if getattr(self.config, "quantization_method", None):
             from luminaai_tpu.training.quantization import QuantizationManager
 
             manager = QuantizationManager(self.config)
-            qparams = manager.quantize_for_inference(params)
-            params = manager.materialize(qparams, model.dtype)
+            params = manager.prepare_serving_params(params, model.dtype)
             self.quantization_info = manager.quantization_info
         self.params = params
         self._decode_fn = {}  # keyed by generation kwargs (static args)

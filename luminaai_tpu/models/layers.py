@@ -17,6 +17,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from luminaai_tpu.config import Config
+from luminaai_tpu.training.quantization import QuantizedTensor
 
 Dtype = Any
 
@@ -154,9 +155,21 @@ class SwiGLU(nn.Module):
             (self.intermediate_size, hidden),
             jnp.float32,
         )
-        fused = jnp.einsum("...d,df->...f", x, wi.astype(self.dtype))
+        if isinstance(wi, QuantizedTensor):
+            # Serving path: real int8 MXU dots (ops/quantized.py), the
+            # TPU form of the ref's kernel-swap quantization
+            # (ref trainer.py:658).
+            from luminaai_tpu.ops.quantized import int8_project
+
+            fused = int8_project(x, wi, self.dtype)
+        else:
+            fused = jnp.einsum("...d,df->...f", x, wi.astype(self.dtype))
         gate, up = jnp.split(fused, 2, axis=-1)
         act = nn.silu(gate) * up
+        if isinstance(wo, QuantizedTensor):
+            from luminaai_tpu.ops.quantized import int8_project
+
+            return int8_project(act, wo, self.dtype)
         return jnp.einsum("...f,fd->...d", act, wo.astype(self.dtype))
 
 
@@ -220,7 +233,21 @@ class GQAttention(nn.Module):
             jnp.float32,
         )
 
-        if cfg.tensor_parallel_size == 1:
+        if any(isinstance(w, QuantizedTensor) for w in (wq, wk, wv)):
+            # Serving path: int8 MXU projections (ops/quantized.py). The
+            # int8 dot is already one wide dot_general per weight, so the
+            # bf16 fused-concat trick below isn't needed here. Per-weight
+            # checks: min_size can leave e.g. the skinnier wk/wv in fp32
+            # while wq quantizes.
+            from luminaai_tpu.ops.quantized import int8_project
+
+            def _proj(w):
+                if isinstance(w, QuantizedTensor):
+                    return int8_project(x, w, self.dtype)
+                return jnp.einsum("bsd,dhk->bshk", x, w.astype(self.dtype))
+
+            q, k, v = _proj(wq), _proj(wk), _proj(wv)
+        elif cfg.tensor_parallel_size == 1:
             # One fused [H, (nq+2*nkv)*d] projection: three skinny matmuls
             # leave the MXU underfed; the weight concat is parameter-sized
             # (a few MB) and XLA folds it. Param tree stays wq/wk/wv so
@@ -244,6 +271,13 @@ class GQAttention(nn.Module):
             q = jnp.einsum("bsd,dhk->bshk", x, wq.astype(self.dtype))
             k = jnp.einsum("bsd,dhk->bshk", x, wk.astype(self.dtype))
             v = jnp.einsum("bsd,dhk->bshk", x, wv.astype(self.dtype))
+
+        def _out_proj(out):
+            if isinstance(wo, QuantizedTensor):
+                from luminaai_tpu.ops.quantized import int8_out_proj
+
+                return int8_out_proj(out, wo, self.dtype)
+            return jnp.einsum("bshk,hkd->bsd", out, wo.astype(self.dtype))
 
         # Runtime length can exceed cfg.seq_length (soft-prompt prefixes
         # prepend virtual tokens); the rope table covers whichever is larger.
@@ -300,7 +334,7 @@ class GQAttention(nn.Module):
                     q, k, v, axis_name="sequence", axis_size=sp,
                     causal=True,
                 )
-            y = jnp.einsum("bshk,hkd->bsd", out, wo.astype(self.dtype))
+            y = _out_proj(out)
             return y, new_cache
 
         # Ring attention: sequence/context parallelism. Activations arrive
@@ -335,7 +369,7 @@ class GQAttention(nn.Module):
                     block_q=cfg.flash_block_q,
                     block_kv=cfg.flash_block_kv,
                 )
-                y = jnp.einsum("bshk,hkd->bsd", out, wo.astype(self.dtype))
+                y = _out_proj(out)
                 return y, new_cache
 
         from luminaai_tpu.ops.flash_attention import flash_eligible
@@ -360,7 +394,7 @@ class GQAttention(nn.Module):
         else:
             out = self._xla_attention(q, k, v, kv_cache is not None, cache_index)
 
-        y = jnp.einsum("bshk,hkd->bsd", out, wo.astype(self.dtype))
+        y = _out_proj(out)
         return y, new_cache
 
     def _xla_attention(self, q, k, v, decoding: bool, cache_index):
@@ -421,7 +455,12 @@ class Embedder(nn.Module):
             )
 
     def encode(self, tokens: jax.Array) -> jax.Array:
-        x = jnp.take(self.embedding, tokens, axis=0).astype(self.dtype)
+        if isinstance(self.embedding, QuantizedTensor):
+            from luminaai_tpu.ops.quantized import embed_rows
+
+            x = embed_rows(self.embedding, tokens, self.dtype)
+        else:
+            x = jnp.take(self.embedding, tokens, axis=0).astype(self.dtype)
         if self.config.use_stable_embedding:
             x = x * jnp.sqrt(float(self.config.hidden_size)).astype(self.dtype)
         return x
@@ -435,6 +474,12 @@ class Embedder(nn.Module):
             if self.config.tie_word_embeddings
             else self.lm_head
         )
+        if isinstance(head, QuantizedTensor):
+            # Serving path: the vocab projection is the single largest
+            # decode matmul — int8 MXU with int32 accumulation, fp32 out.
+            from luminaai_tpu.ops.quantized import int8_attend
+
+            return int8_attend(x, head, jnp.float32)
         return jnp.einsum(
             "bsd,vd->bsv",
             x,

@@ -27,6 +27,7 @@ from flax import linen as nn
 
 from luminaai_tpu.config import Config
 from luminaai_tpu.models.layers import default_init
+from luminaai_tpu.training.quantization import QuantizedTensor
 
 Dtype = Any
 
@@ -290,7 +291,14 @@ class MoELayer(nn.Module):
             gate_logits = jnp.where(keep[None, None, :], gate_logits, -1e9)
         router_probs = jax.nn.softmax(gate_logits, axis=-1)
 
-        if cfg.moe_dispatch == "gmm":
+        # Quantized serving: the gmm kernel is bf16-only, so int8 expert
+        # weights route through the gather buffers (decode shapes rarely
+        # satisfy gmm's 128-row tiling anyway).
+        dispatch_mode = cfg.moe_dispatch
+        if isinstance(wi, QuantizedTensor) and dispatch_mode == "gmm":
+            dispatch_mode = "gather"
+
+        if dispatch_mode == "gmm":
             # Ragged grouped matmul via the Pallas megablox kernel: tokens
             # sorted by expert, each expert's FFN runs over exactly its
             # kept rows — no [E, G, C, H] capacity-padded buffers and no
@@ -300,7 +308,7 @@ class MoELayer(nn.Module):
             out, tokens_per_expert, dropped = self._gmm_path(
                 x, router_probs, wi, wo, capacity
             )
-        elif cfg.moe_dispatch in ("sort", "gather"):
+        elif dispatch_mode in ("sort", "gather"):
             # Sort-based dispatch: scatter/gather via flat slot ids — no
             # [G,S,E,C] one-hot tensors (see _sort_routing). The expert FFN
             # below still runs dense [E,G,C,·] matmuls on the MXU.
@@ -312,7 +320,7 @@ class MoELayer(nn.Module):
                 jnp.arange(S)[:, None], (S, k)
             ).reshape(-1)
 
-            if cfg.moe_dispatch == "gather":
+            if dispatch_mode == "gather":
                 # Invert slot→token into an index table first (cheap int32
                 # scatter), then fill the expert buffers with a row GATHER
                 # — directly in the [E, G, C, H] expert-major layout, so no
@@ -361,7 +369,7 @@ class MoELayer(nn.Module):
                 "gsec->e", dispatch.astype(jnp.float32)
             )
 
-        if cfg.moe_dispatch != "gmm":
+        if dispatch_mode != "gmm":
             # Manual expert parallelism (inside the 1F1B manual-pipe region):
             # tokens arrive SHARDED over the 'expert' mesh axis (ep borrows the
             # data dimension, the DeepSpeed-MoE layout), this shard's wi/wo
@@ -382,10 +390,26 @@ class MoELayer(nn.Module):
                 expert_in = nn.with_logical_constraint(
                     expert_in, ("expert", "activation_exp_batch", None, None)
                 )
-            fused = jnp.einsum("egch,ehf->egcf", expert_in, wi.astype(self.dtype))
+            if isinstance(wi, QuantizedTensor):
+                # Serving path: per-expert int8 MXU dots (ops/quantized.py)
+                # — the TPU form of the ref's kernel-swap quantization.
+                from luminaai_tpu.ops.quantized import int8_expert
+
+                fused = int8_expert(expert_in, wi, self.dtype)
+            else:
+                fused = jnp.einsum(
+                    "egch,ehf->egcf", expert_in, wi.astype(self.dtype)
+                )
             gate_act, up = jnp.split(fused, 2, axis=-1)
             act = nn.silu(gate_act) * up
-            expert_out = jnp.einsum("egcf,efh->egch", act, wo.astype(self.dtype))
+            if isinstance(wo, QuantizedTensor):
+                from luminaai_tpu.ops.quantized import int8_expert
+
+                expert_out = int8_expert(act, wo, self.dtype)
+            else:
+                expert_out = jnp.einsum(
+                    "egcf,efh->egch", act, wo.astype(self.dtype)
+                )
             if manual_ep:
                 # [E/ep, ep*G, C, H] -> [E, G, C, H]: every token group gets
                 # all experts' outputs back for the local combine.
@@ -397,7 +421,7 @@ class MoELayer(nn.Module):
                     expert_out, ("expert", "activation_exp_batch", None, None)
                 )
 
-            if cfg.moe_dispatch in ("sort", "gather"):
+            if dispatch_mode in ("sort", "gather"):
                 # Dropped pairs carry slot == E*C (one past the end) AND
                 # gate == 0: clamping the index gathers an arbitrary row that
                 # the zero gate annihilates — no zero-row concatenate (a full

@@ -1,0 +1,123 @@
+"""Int8 MXU compute for the serving path (W8A8, dynamic activations).
+
+The reference swaps actual compute kernels when quantizing — bnb
+Linear8bitLt replacement, GPTQ, quanto (ref: Src/Main_Scripts/training/
+trainer.py:658 _replace_linear_layers_8bit, :681 quantize_model_gptq,
+:712 quantize_model_quanto). The TPU-native counterpart is an
+int8xint8→int32 `lax.dot_general` on the MXU, where v5e int8 peak is
+~2x bf16 (394 vs 197 TFLOP/s): weights carry static per-output-channel
+scales (reduced over the CONTRACTION axis at quantization time, so the
+scale factors out of the dot), activations are quantized dynamically
+per row. Everything here is shape-static and jit-traceable, so decode
+steps stay one compiled program.
+
+Weight layout contracts (enforced by asserts; produced by
+training.quantization.quantize_for_serving):
+  - int8_project:  w [K, *O], contraction K = axis 0, scale [1, *O]
+  - int8_attend:   w [V, K],  contraction K = last axis, scale [V, 1]
+  - int8_expert:   w [E, K, N], batch E, contraction K = axis 1,
+                   scale [E, 1, N]
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from luminaai_tpu.training.quantization import QuantizedTensor
+
+
+def quantize_act(x: jax.Array):
+    """Dynamic symmetric per-row int8: scale over the last axis.
+
+    Returns (codes int8 [..., K], scale fp32 [..., 1])."""
+    x32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=-1, keepdims=True)
+    s = jnp.where(amax > 0, amax / 127.0, 1.0)
+    xq = jnp.clip(jnp.round(x32 / s), -127, 127).astype(jnp.int8)
+    return xq, s
+
+
+def _check(qt: QuantizedTensor, contraction_axes) -> None:
+    assert qt.bits == 8, "int8 compute path needs 8-bit codes"
+    axes = qt.axis if isinstance(qt.axis, tuple) else (qt.axis,)
+    want = tuple(a % qt.q.ndim for a in contraction_axes)
+    got = tuple(a % qt.q.ndim for a in axes)
+    assert got == want, (
+        f"weight quantized over axes {got}, int8 kernel contracts {want} — "
+        "re-quantize with quantize_for_serving"
+    )
+
+
+def int8_project(x: jax.Array, qt: QuantizedTensor, out_dtype) -> jax.Array:
+    """x [..., K] · w [K, *O] → [..., *O] with int8 MXU accumulation."""
+    _check(qt, (0,))
+    xq, sx = quantize_act(x)
+    out_dims = qt.q.shape[1:]
+    q2 = qt.q.reshape(qt.q.shape[0], -1)
+    y = jax.lax.dot_general(
+        xq, q2,
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    ).reshape(*x.shape[:-1], *out_dims).astype(jnp.float32)
+    sx = sx.reshape(sx.shape[:-1] + (1,) * len(out_dims))
+    sw = qt.scale.reshape(out_dims)  # [1, *O] → [*O], broadcasts trailing
+    return (y * sx * sw).astype(out_dtype)
+
+
+def int8_attend(
+    x: jax.Array, qt: QuantizedTensor, out_dtype=jnp.float32
+) -> jax.Array:
+    """x [..., K] · w [V, K] → [..., V] (the vocab head / tied-embedding
+    decode — at generation time the single largest matmul)."""
+    _check(qt, (qt.q.ndim - 1,))
+    xq, sx = quantize_act(x)
+    y = jax.lax.dot_general(
+        xq, qt.q,
+        (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    ).astype(jnp.float32)
+    return (y * sx * qt.scale.reshape(-1)).astype(out_dtype)
+
+
+def int8_out_proj(x: jax.Array, qt: QuantizedTensor, out_dtype) -> jax.Array:
+    """x [..., A, B] · w [A, B, H] → [..., H] (attention output
+    projection: contract heads·head_dim together, scale [1, 1, H])."""
+    _check(qt, (0, 1))
+    k = qt.q.shape[0] * qt.q.shape[1]
+    xf = x.reshape(*x.shape[:-2], k)
+    xq, sx = quantize_act(xf)
+    y = jax.lax.dot_general(
+        xq, qt.q.reshape(k, qt.q.shape[-1]),
+        (((xf.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    ).astype(jnp.float32)
+    return (y * sx * qt.scale.reshape(-1)).astype(out_dtype)
+
+
+def int8_expert(x: jax.Array, qt: QuantizedTensor, out_dtype) -> jax.Array:
+    """x [E, ..., K] · w [E, K, N] → [E, ..., N], batched over experts."""
+    _check(qt, (1,))
+    xq, sx = quantize_act(x)
+    mid = x.shape[1:-1]
+    xq2 = xq.reshape(x.shape[0], -1, x.shape[-1])
+    y = jax.lax.dot_general(
+        xq2, qt.q,
+        (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.int32,
+    ).reshape(x.shape[0], *mid, qt.q.shape[-1]).astype(jnp.float32)
+    sw = qt.scale.reshape(
+        qt.scale.shape[0], *([1] * len(mid)), qt.scale.shape[-1]
+    )
+    return (y * sx * sw).astype(out_dtype)
+
+
+def embed_rows(
+    qt: QuantizedTensor, tokens: jax.Array, dtype
+) -> jax.Array:
+    """Row lookup from an int8 embedding table ([V, H], scale [V, 1]):
+    gather codes + per-row scales, dequantize only the gathered rows."""
+    _check(qt, (qt.q.ndim - 1,))
+    rows = jnp.take(qt.q, tokens, axis=0).astype(jnp.float32)
+    s = jnp.take(qt.scale.reshape(-1), tokens, axis=0)[..., None]
+    return (rows * s).astype(dtype)

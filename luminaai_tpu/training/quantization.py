@@ -59,10 +59,21 @@ class QuantizedTensor(struct.PyTreeNode):
 
 
 def quantize_array(
-    w: jax.Array, bits: int = 8, axis: int = -1
+    w: jax.Array, bits: int = 8, axis=-1
 ) -> QuantizedTensor:
-    """Symmetric per-channel quantization along every axis except `axis`."""
-    axis = axis % w.ndim
+    """Symmetric per-channel quantization, scales reduced over `axis`.
+
+    `axis` may be a tuple (int8 only) — the serving path quantizes over
+    the matmul CONTRACTION axes so the scale factors out of the int8 dot
+    (ops/quantized.py's layout contracts)."""
+    if isinstance(axis, tuple):
+        if bits == 4:
+            raise ValueError("multi-axis quantization is int8-only")
+        axis = tuple(a % w.ndim for a in axis)
+        if len(axis) == 1:
+            axis = axis[0]
+    else:
+        axis = axis % w.ndim
     w32 = w.astype(jnp.float32)
     qmax = 127.0 if bits == 8 else 7.0
     amax = jnp.max(jnp.abs(w32), axis=axis, keepdims=True)
@@ -119,6 +130,75 @@ def quantize_tree(
             out.append(leaf)
     info = {
         "bits": bits,
+        "quantized_leaves": quantized,
+        "total_leaves": len(flat),
+        "bytes_before": before,
+        "bytes_after": after,
+        "compression": before / max(after, 1),
+    }
+    return jax.tree_util.tree_unflatten(treedef, out), info
+
+
+def _serving_axis(keys: Tuple[str, ...], leaf: jax.Array):
+    """Contraction axes for the int8 COMPUTE path, chosen by the weight's
+    role in the model (see ops/quantized.py layout contracts). Returns
+    None for leaves the compute path doesn't handle — they stay in full
+    precision rather than silently falling back to dequantize-matmul."""
+    name = keys[-1] if keys else ""
+    if leaf.ndim < 2:
+        return None
+    if name in ("embedding", "lm_head"):
+        return (leaf.ndim - 1,)  # [V, H] contract H (attend/decode)
+    if name in ("wq", "wk", "wv"):
+        return (0,)  # [H, heads, d] contract H
+    in_moe = any("moe" in k.lower() for k in keys)
+    if name == "wi":
+        return (1,) if leaf.ndim == 3 else (0,)  # experts [E,H,2F] / [H,2F]
+    if name == "wo":
+        if leaf.ndim == 3 and in_moe:
+            return (1,)  # [E, F, H] contract F
+        if leaf.ndim == 3:
+            return (0, 1)  # attention [heads, d, H] contract heads·d
+        return (0,)  # SwiGLU [F, H]
+    return None
+
+
+def quantize_for_serving(
+    params: Any, min_size: int = 4096
+) -> Tuple[Any, Dict[str, Any]]:
+    """Quantize a param tree for int8 COMPUTE at decode time.
+
+    Unlike quantize_tree (storage-only: scales over the output axis,
+    dequantized before every matmul), this reduces scales over each
+    weight's matmul CONTRACTION axes so the model's quantization-aware
+    call sites (Embedder/SwiGLU/GQAttention/MoELayer) run real
+    int8xint8→int32 MXU dots via ops/quantized.py — the TPU counterpart
+    of the reference's kernel-swapping quantization (ref trainer.py:658).
+    """
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    out = []
+    before = after = quantized = 0
+    for path, leaf in flat:
+        keys = tuple(
+            p.key for p in path if isinstance(p, jax.tree_util.DictKey)
+        )
+        before += leaf.nbytes
+        axes = (
+            _serving_axis(keys, leaf)
+            if _eligible(keys, leaf, min_size)
+            else None
+        )
+        if axes is not None:
+            qt = quantize_array(leaf, bits=8, axis=axes)
+            after += qt.q.nbytes + qt.scale.nbytes
+            quantized += 1
+            out.append(qt)
+        else:
+            after += leaf.nbytes
+            out.append(leaf)
+    info = {
+        "bits": 8,
+        "mode": "int8_compute",
         "quantized_leaves": quantized,
         "total_leaves": len(flat),
         "bytes_before": before,
@@ -196,3 +276,37 @@ class QuantizationManager:
     def materialize(self, qparams: Any, dtype=jnp.bfloat16) -> Any:
         """Dequantize for use with the standard apply path."""
         return dequantize_tree(qparams, dtype)
+
+    def prepare_serving_params(self, params: Any, dtype=jnp.bfloat16) -> Any:
+        """Params as the generation engine should hold them.
+
+        int8 → QuantizedTensor leaves in the compute layout: the model's
+        quantization-aware call sites run real int8 MXU dots (v5e int8
+        peak ~2x bf16) — the TPU counterpart of the ref's kernel swap
+        (ref trainer.py:658). int4 → storage-only (packed nibbles have no
+        MXU dtype): dequantized to bf16, halving checkpoint/HBM only.
+        """
+        if not self.enabled:
+            return params
+        if self.bits == 8 and getattr(self.config, "scan_layers", False):
+            # Scanned checkpoints stack layer params on a leading L axis;
+            # nn.scan slices q and scale per layer but the static
+            # contraction-axis metadata can't shift with it — keep the
+            # layout-agnostic storage-only path for those trees.
+            logger.info(
+                "int8 compute path skipped for scan_layers tree "
+                "(storage-only quantization applied)"
+            )
+        elif self.bits == 8:
+            qparams, info = quantize_for_serving(params)
+            self.is_quantized = True
+            self.quantization_info = info
+            logger.info(
+                "int8 COMPUTE quantization: %d/%d leaves, %.2fx bytes "
+                "(%.1f MB → %.1f MB)",
+                info["quantized_leaves"], info["total_leaves"],
+                info["compression"], info["bytes_before"] / 1e6,
+                info["bytes_after"] / 1e6,
+            )
+            return qparams
+        return self.materialize(self.quantize_for_inference(params), dtype)

@@ -3,7 +3,11 @@
 
 Measures single-stream vs batched aggregate decode tokens/sec on a small
 random-weight model (VERDICT r2 weak #5: serving was one sequence at a
-time). Usage: python scripts/serve_bench.py [batch_sizes ...]
+time), then repeats the sweep with int8 COMPUTE quantization
+(quantization_method='int8': real int8 MXU dots via ops/quantized.py —
+v5e int8 peak is ~2x bf16, so the quantized rows are the kernel-swap A/B
+the ref does with bnb/GPTQ). Usage:
+python scripts/serve_bench.py [batch_sizes ...]
 """
 import os
 import sys
@@ -47,32 +51,44 @@ def main() -> None:
     from flax.linen import meta
 
     params = meta.unbox(params)
-    engine = GenerationEngine(model, params, tok, cfg)
 
-    rng = np.random.RandomState(0)
-    mk = lambda: rng.randint(5, 200, size=rng.randint(4, 48)).tolist()
-
-    # Warm single-stream, then time it.
-    engine.generate(mk(), seed=0)
-    t0 = time.perf_counter()
-    n = 0
-    for i in range(4):
-        toks, _ = engine.generate(mk(), seed=i)
-        n += len(toks)
-    single_tps = n / (time.perf_counter() - t0)
-    print(f"platform={platform} single-stream: {single_tps:.1f} tok/s")
-
-    for B in batches:
-        prompts = [mk() for _ in range(B)]
-        engine.generate_batch(prompts, seed=0)  # compile
+    def sweep(engine, label):
+        # Fresh seeded stream per arm: both sweeps time the IDENTICAL
+        # prompt sequence, so the int8/bf16 ratio measures the kernel
+        # swap, not workload variance.
+        rng = np.random.RandomState(0)
+        mk = lambda: rng.randint(5, 200, size=rng.randint(4, 48)).tolist()
+        engine.generate(mk(), seed=0)  # compile + warm
         t0 = time.perf_counter()
-        res = engine.generate_batch(prompts, seed=1)
-        dt = time.perf_counter() - t0
-        total = sum(len(t) for t, _ in res)
+        n = 0
+        for i in range(4):
+            toks, _ = engine.generate(mk(), seed=i)
+            n += len(toks)
+        single_tps = n / (time.perf_counter() - t0)
         print(
-            f"batch={B}: {total / dt:.1f} tok/s aggregate "
-            f"({total / dt / single_tps:.2f}x single-stream)"
+            f"platform={platform} [{label}] single-stream: "
+            f"{single_tps:.1f} tok/s"
         )
+        for B in batches:
+            prompts = [mk() for _ in range(B)]
+            engine.generate_batch(prompts, seed=0)  # compile
+            t0 = time.perf_counter()
+            res = engine.generate_batch(prompts, seed=1)
+            dt = time.perf_counter() - t0
+            total = sum(len(t) for t, _ in res)
+            print(
+                f"[{label}] batch={B}: {total / dt:.1f} tok/s aggregate "
+                f"({total / dt / single_tps:.2f}x single-stream)"
+            )
+        return single_tps
+
+    bf16_tps = sweep(GenerationEngine(model, params, tok, cfg), "bf16")
+
+    import dataclasses
+
+    qcfg = dataclasses.replace(cfg, quantization_method="int8")
+    q_tps = sweep(GenerationEngine(model, params, tok, qcfg), "int8")
+    print(f"int8/bf16 single-stream: {q_tps / bf16_tps:.2f}x")
 
 
 if __name__ == "__main__":

@@ -122,3 +122,172 @@ def test_quantized_model_forward_close_and_generates():
     )
     assert len(out_ids) >= 1
     assert all(0 <= t < cfg.vocab_size for t in out_ids)
+
+
+# ---------------------------------------------------------------------------
+# int8 COMPUTE path (W8A8, ops/quantized.py) — ref trainer.py:658 kernel swap
+# ---------------------------------------------------------------------------
+def _relerr(a, b):
+    return float(jnp.abs(a - b).max() / (jnp.abs(b).max() + 1e-9))
+
+
+def test_int8_project_matches_dequant_matmul():
+    from luminaai_tpu.ops.quantized import int8_project
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(4, 7, 64), jnp.float32)
+    # 2D weight [K, N]
+    w2 = jnp.asarray(rng.randn(64, 96), jnp.float32) * 0.02
+    qt2 = quantize_array(w2, bits=8, axis=(0,))
+    y = int8_project(x, qt2, jnp.float32)
+    ref = x @ qt2.dequantize(jnp.float32)
+    assert y.shape == (4, 7, 96)
+    assert _relerr(y, ref) < 0.02, _relerr(y, ref)
+    # 3D weight [K, h, d] (attention projection shape)
+    w3 = jnp.asarray(rng.randn(64, 4, 16), jnp.float32) * 0.02
+    qt3 = quantize_array(w3, bits=8, axis=(0,))
+    y3 = int8_project(x, qt3, jnp.float32)
+    ref3 = jnp.einsum("bsk,khd->bshd", x, qt3.dequantize(jnp.float32))
+    assert y3.shape == (4, 7, 4, 16)
+    assert _relerr(y3, ref3) < 0.02
+
+
+def test_int8_attend_and_out_proj_match():
+    from luminaai_tpu.ops.quantized import int8_attend, int8_out_proj
+
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, 5, 64), jnp.float32)
+    emb = jnp.asarray(rng.randn(256, 64), jnp.float32) * 0.02
+    qe = quantize_array(emb, bits=8, axis=(-1,))
+    y = int8_attend(x, qe, jnp.float32)
+    ref = jnp.einsum("bsk,vk->bsv", x, qe.dequantize(jnp.float32))
+    assert y.shape == (2, 5, 256)
+    assert _relerr(y, ref) < 0.02
+
+    out = jnp.asarray(rng.randn(2, 5, 4, 16), jnp.float32)
+    wo = jnp.asarray(rng.randn(4, 16, 64), jnp.float32) * 0.02
+    qo = quantize_array(wo, bits=8, axis=(0, 1))
+    y2 = int8_out_proj(out, qo, jnp.float32)
+    ref2 = jnp.einsum("bshk,hkd->bsd", out, qo.dequantize(jnp.float32))
+    assert _relerr(y2, ref2) < 0.02
+
+
+def test_int8_expert_matches():
+    from luminaai_tpu.ops.quantized import int8_expert
+
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(8, 2, 16, 64), jnp.float32)
+    w = jnp.asarray(rng.randn(8, 64, 32), jnp.float32) * 0.02
+    qt = quantize_array(w, bits=8, axis=(1,))
+    y = int8_expert(x, qt, jnp.float32)
+    ref = jnp.einsum("egch,ehf->egcf", x, qt.dequantize(jnp.float32))
+    assert y.shape == (8, 2, 16, 32)
+    assert _relerr(y, ref) < 0.02
+
+
+def test_int8_embed_rows_match():
+    from luminaai_tpu.ops.quantized import embed_rows
+
+    rng = np.random.RandomState(3)
+    emb = jnp.asarray(rng.randn(128, 64), jnp.float32) * 0.02
+    qe = quantize_array(emb, bits=8, axis=(-1,))
+    toks = jnp.asarray(rng.randint(0, 128, (2, 9)), jnp.int32)
+    rows = embed_rows(qe, toks, jnp.float32)
+    ref = jnp.take(qe.dequantize(jnp.float32), toks, axis=0)
+    np.testing.assert_allclose(np.asarray(rows), np.asarray(ref), atol=1e-5)
+
+
+def test_quantize_for_serving_axes_and_roles():
+    from luminaai_tpu.training.quantization import quantize_for_serving
+
+    cfg = tiny_config(use_moe=True, num_experts=4, moe_top_k=2)
+    model = LuminaTransformer(cfg)
+    ids = jnp.ones((1, 32), jnp.int32)
+    params = model.init(jax.random.key(0), ids)["params"]
+    qp, info = quantize_for_serving(params, min_size=1024)
+    assert info["quantized_leaves"] > 0
+    flat = jax.tree_util.tree_flatten_with_path(
+        qp, is_leaf=lambda x: isinstance(x, QuantizedTensor)
+    )[0]
+    for path, leaf in flat:
+        keys = tuple(
+            p.key for p in path if isinstance(p, jax.tree_util.DictKey)
+        )
+        name = keys[-1]
+        if not isinstance(leaf, QuantizedTensor):
+            assert name in ("scale", "bias", "router") or leaf.size < 1024, keys
+            continue
+        # Scale must be reduced over the CONTRACTION axes of each role.
+        if name == "embedding":
+            assert leaf.scale.shape == (leaf.orig_shape[0], 1)
+        elif name in ("wq", "wk", "wv"):
+            assert leaf.scale.shape == (1,) + leaf.orig_shape[1:]
+        elif name == "wi":  # moe [E, H, 2F]
+            assert leaf.scale.shape == (
+                leaf.orig_shape[0], 1, leaf.orig_shape[2]
+            )
+        elif name == "wo":
+            if any("moe" in k for k in keys):
+                assert leaf.scale.shape == (
+                    leaf.orig_shape[0], 1, leaf.orig_shape[2]
+                )
+            else:  # attention [heads, d, H]
+                assert leaf.scale.shape == (1, 1, leaf.orig_shape[2])
+
+
+@pytest.mark.parametrize("use_moe", [False, True])
+def test_int8_compute_model_forward_close(use_moe):
+    """End-to-end quality delta: the model applied with QuantizedTensor
+    leaves (real int8 dots at every quantization-aware call site) stays
+    close to the fp32 forward — and actually runs the int8 path (pinned
+    by the serving-layout scale shapes above)."""
+    from luminaai_tpu.training.quantization import quantize_for_serving
+
+    cfg = tiny_config(
+        use_moe=use_moe, num_experts=4, moe_top_k=2,
+        routing_noise_std=0.0,
+    )
+    model = LuminaTransformer(cfg)
+    ids = jnp.asarray(
+        np.random.RandomState(0).randint(1, 256, (2, 32)), jnp.int32
+    )
+    params = model.init(jax.random.key(0), ids)["params"]
+    logits, _ = model.apply({"params": params}, ids, deterministic=True)
+    qp, _ = quantize_for_serving(params, min_size=1024)
+    qlogits, _ = model.apply({"params": qp}, ids, deterministic=True)
+    assert qlogits.shape == logits.shape
+    agree = float(
+        (jnp.argmax(logits, -1) == jnp.argmax(qlogits, -1)).mean()
+    )
+    assert agree > 0.9, agree
+
+
+def test_int8_scan_layers_falls_back_to_storage_path():
+    """Scanned checkpoints stack layer params on a leading L axis; the
+    int8 compute layout's static contraction axes can't survive nn.scan
+    slicing, so serving must fall back to the layout-agnostic
+    storage-only quantization — and still generate."""
+    cfg = tiny_config(quantization_method="int8", scan_layers=True)
+    model = LuminaTransformer(cfg)
+    ids = jnp.ones((1, 8), jnp.int32)
+    from flax.linen import meta
+
+    params = meta.unbox(model.init(jax.random.key(0), ids)["params"])
+
+    from luminaai_tpu.data.tokenizer import ConversationTokenizer
+    from luminaai_tpu.inference.generate import GenerationEngine
+
+    tok = ConversationTokenizer(model_name="byte")
+    engine = GenerationEngine(model, params, tok, config=cfg)
+    assert engine.quantization_info.get("mode") != "int8_compute"
+    assert not any(
+        isinstance(l, QuantizedTensor)
+        for l in jax.tree_util.tree_leaves(
+            engine.params,
+            is_leaf=lambda x: isinstance(x, QuantizedTensor),
+        )
+    )
+    out_ids, _ = engine.generate(
+        [1, 2, 3], max_new_tokens=4, temperature=0.0, seed=0
+    )
+    assert len(out_ids) >= 1
