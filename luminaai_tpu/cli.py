@@ -168,7 +168,10 @@ def make_data(cfg, args):
             process_count=pc,
         )
         return (
-            PrefetchLoader(lambda: iter(ds), prefetch=max(1, cfg.num_workers)),
+            PrefetchLoader(
+                lambda: iter(ds), prefetch=max(1, cfg.num_workers),
+                source=ds,  # curriculum set_difficulty forwards to the ds
+            ),
             None, cache.n_tokens,
         )
 

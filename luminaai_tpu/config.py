@@ -252,6 +252,12 @@ class Config:
     # len(schedule) equal phases; each change recompiles the step.
     enable_mod_capacity_adaptation: bool = False
     mod_capacity_schedule: tuple = (0.7, 0.5, 0.3)
+    # Learning-velocity curriculum (ref chinchilla_scaler.py:155
+    # AdaptiveCurriculumManager): the orchestrator tracks per-step loss
+    # reduction and forwards the recommended difficulty to any data loader
+    # exposing set_difficulty (PackedDataset maps it to a doc-length
+    # quantile; takes effect at the next epoch restart).
+    enable_adaptive_curriculum: bool = False
     intervention_cooldown_steps: int = 200
 
     # --- Chinchilla scaling ---

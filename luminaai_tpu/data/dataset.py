@@ -328,18 +328,37 @@ class PackedDataset:
         self.process_index = process_index
         self.process_count = process_count
         self.local_batch = batch_size // process_count
+        self.difficulty: Optional[float] = None
 
     def batches_per_epoch(self) -> int:
         per_batch = self.batch_size * self.seq_length
         return max(1, self.cache.n_tokens // per_batch)
+
+    def set_difficulty(self, difficulty: float) -> None:
+        """Length-quantile curriculum (the orchestrator's consumer for the
+        ref's AdaptiveCurriculumManager signal, chinchilla_scaler.py:155):
+        difficulty d admits documents up to the d-quantile of the doc
+        length distribution — short/easy docs first, the long tail as the
+        model earns it. Deterministic from shared metadata, so multi-host
+        shards stay disjoint and in lockstep. Applies to the NEXT epoch's
+        iteration (a running iterator keeps its order)."""
+        self.difficulty = float(np.clip(difficulty, 0.0, 1.0))
 
     def _global_order(self) -> np.ndarray:
         """The one doc order every host derives identically (shared seed),
         so the per-host strides below are disjoint + exhaustive."""
         n = self.cache.n_docs
         if self.shuffle_seed is not None:
-            return np.asarray(shuffle_indices(n, self.shuffle_seed))
-        return np.arange(n)
+            order = np.asarray(shuffle_indices(n, self.shuffle_seed))
+        else:
+            order = np.arange(n)
+        if self.difficulty is not None and self.difficulty < 1.0:
+            doclens = np.diff(self.cache.offsets)
+            cutoff = np.quantile(doclens, max(self.difficulty, 0.05))
+            keep = doclens[order] <= cutoff
+            if keep.any():  # never filter down to an empty epoch
+                order = order[keep]
+        return order
 
     def _doc_order(self, host: int, wrap: int = 0) -> np.ndarray:
         """Doc ids host `host` walks this epoch (its stride of the global
@@ -369,7 +388,8 @@ class PackedDataset:
         )
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
-        if self.process_count == 1 and self.shuffle_seed is None:
+        filtered = self.difficulty is not None and self.difficulty < 1.0
+        if self.process_count == 1 and self.shuffle_seed is None and not filtered:
             # Fast path: sequential cursor straight over the memmap, no
             # per-doc copies.
             offsets = self.cache.offsets
@@ -484,9 +504,20 @@ class PrefetchLoader:
         self,
         batch_fn: Callable[[], Iterator[Dict[str, np.ndarray]]],
         prefetch: int = 2,
+        source: Optional[Any] = None,
     ):
         self.batch_fn = batch_fn
         self.prefetch = max(1, prefetch)
+        # The dataset behind batch_fn, when the caller wants curriculum
+        # signals (set_difficulty) forwarded through the loader.
+        self.source = source
+
+    def set_difficulty(self, difficulty: float) -> bool:
+        target = getattr(self.source, "set_difficulty", None)
+        if callable(target):
+            target(difficulty)
+            return True
+        return False
 
     def __call__(self) -> Iterator[Dict[str, np.ndarray]]:
         return self.__iter__()

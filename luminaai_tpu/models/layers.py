@@ -17,7 +17,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from luminaai_tpu.config import Config
-from luminaai_tpu.training.quantization import QuantizedTensor
+from luminaai_tpu.ops.quantized import QuantizedTensor
 
 Dtype = Any
 

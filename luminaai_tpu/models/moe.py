@@ -27,7 +27,7 @@ from flax import linen as nn
 
 from luminaai_tpu.config import Config
 from luminaai_tpu.models.layers import default_init
-from luminaai_tpu.training.quantization import QuantizedTensor
+from luminaai_tpu.ops.quantized import QuantizedTensor
 
 Dtype = Any
 

@@ -21,10 +21,85 @@ training.quantization.quantize_for_serving):
 
 from __future__ import annotations
 
+from typing import Tuple
+
 import jax
 import jax.numpy as jnp
+from flax import struct
 
-from luminaai_tpu.training.quantization import QuantizedTensor
+
+class QuantizedTensor(struct.PyTreeNode):
+    """Per-channel symmetric weight-only quantized array.
+
+    q holds int8 codes ([-127,127] for 8-bit; two int4 nibbles per byte
+    for 4-bit, packed along the quantization axis). scale is fp32, shaped
+    like the original with the quantized axis/axes reduced to 1. Lives in
+    ops/ (next to its kernels) so models/ can consume it without
+    depending on the training package.
+    """
+
+    q: jax.Array
+    scale: jax.Array
+    bits: int = struct.field(pytree_node=False)
+    axis: Tuple[int, ...] = struct.field(pytree_node=False)
+    orig_shape: Tuple[int, ...] = struct.field(pytree_node=False)
+
+    def dequantize(self, dtype=jnp.bfloat16) -> jax.Array:
+        if self.bits == 4:
+            packed = self.q.astype(jnp.int8)
+            low = jnp.left_shift(packed, 4) >> 4  # sign-extended low nibble
+            high = packed >> 4
+            vals = jnp.stack([low, high], axis=self.axis + 1)
+            new_shape = list(self.q.shape)
+            new_shape[self.axis] *= 2
+            vals = vals.reshape(new_shape)
+            # Un-pad to the original length along the packed axis.
+            idx = [slice(None)] * vals.ndim
+            idx[self.axis] = slice(0, self.orig_shape[self.axis])
+            vals = vals[tuple(idx)]
+        else:
+            vals = self.q
+        return (vals.astype(jnp.float32) * self.scale).astype(dtype)
+
+
+def quantize_array(
+    w: jax.Array, bits: int = 8, axis=-1
+) -> QuantizedTensor:
+    """Symmetric per-channel quantization, scales reduced over `axis`.
+
+    `axis` may be a tuple (int8 only) — the serving path quantizes over
+    the matmul CONTRACTION axes so the scale factors out of the int8 dot
+    (the layout contracts above)."""
+    if isinstance(axis, tuple):
+        if bits == 4:
+            raise ValueError("multi-axis quantization is int8-only")
+        axis = tuple(a % w.ndim for a in axis)
+        if len(axis) == 1:
+            axis = axis[0]
+    else:
+        axis = axis % w.ndim
+    w32 = w.astype(jnp.float32)
+    qmax = 127.0 if bits == 8 else 7.0
+    amax = jnp.max(jnp.abs(w32), axis=axis, keepdims=True)
+    scale = jnp.where(amax > 0, amax / qmax, 1.0)
+    q = jnp.clip(jnp.round(w32 / scale), -qmax, qmax).astype(jnp.int8)
+    if bits == 4:
+        n = q.shape[axis]
+        if n % 2:  # pad to an even length for nibble packing
+            pad = [(0, 0)] * q.ndim
+            pad[axis] = (0, 1)
+            q = jnp.pad(q, pad)
+        lohi = q.reshape(
+            *q.shape[:axis], q.shape[axis] // 2, 2, *q.shape[axis + 1:]
+        )
+        low = jax.lax.index_in_dim(lohi, 0, axis + 1, keepdims=False)
+        high = jax.lax.index_in_dim(lohi, 1, axis + 1, keepdims=False)
+        q = (
+            (high.astype(jnp.int32) << 4) | (low.astype(jnp.int32) & 0xF)
+        ).astype(jnp.int8)
+    return QuantizedTensor(
+        q=q, scale=scale, bits=bits, axis=axis, orig_shape=tuple(w.shape)
+    )
 
 
 def quantize_act(x: jax.Array):

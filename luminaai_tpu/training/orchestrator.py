@@ -561,6 +561,10 @@ class AdaptiveTrainingOrchestrator:
             f"{self.config.output_dir}/meta_history.jsonl"
         )
         self.production = ProductionMonitoring()
+        from luminaai_tpu.training.scaler import AdaptiveCurriculum
+
+        self.curriculum = AdaptiveCurriculum()
+        self._applied_difficulty: Optional[float] = None
         self.decisions: List[AdaptiveDecision] = []
         self._last_intervention_step = -10**9
         self._last_health_check_step = 0
@@ -612,6 +616,7 @@ class AdaptiveTrainingOrchestrator:
         self.analytics.observe(step, loss, grad_norm, util)
         self.hyper.observe(step, loss, grad_norm)
         self.batcher.observe(loss, grad_norm)
+        self.curriculum.update(loss)
         if util is not None:
             self.evolution.observe(util, metrics.get("moe_drop_rate", 0.0))
         if self.config.use_moe and "moe_drop_rate" in metrics:
@@ -783,6 +788,26 @@ class AdaptiveTrainingOrchestrator:
                     step=step,
                 )
 
+        if self.config.enable_adaptive_curriculum and in_body:
+            # Learning-velocity curriculum (ref chinchilla_scaler.py:155):
+            # re-aim the data loader's difficulty when the recommendation
+            # has moved materially from what's applied. Epoch-granular and
+            # recompile-free, so the confidence bar is easy to meet.
+            d = self.curriculum.difficulty()
+            prev = self._applied_difficulty
+            if prev is None or abs(d - prev) >= 0.15:
+                return AdaptiveDecision(
+                    kind="curriculum",
+                    params={"difficulty": round(d, 3)},
+                    reason=(
+                        "learning velocity recommends difficulty "
+                        f"{d:.2f} (applied: "
+                        f"{'none' if prev is None else f'{prev:.2f}'})"
+                    ),
+                    confidence=0.6,
+                    step=step,
+                )
+
         if self.config.enable_adaptive_wd and in_body:
             # Slow sustained loss rise that never trips the spike/divergence
             # rules above: add regularization (ref trainer.py:1792's stated
@@ -901,6 +926,14 @@ class AdaptiveTrainingOrchestrator:
                     decision.params["new_value"], reason=decision.reason
                 )
                 applied = True
+            elif kind == "curriculum":
+                applied = t.set_data_difficulty(
+                    decision.params["difficulty"], reason=decision.reason
+                )
+                # Remember the target even when the loader has no
+                # curriculum hook, so the decision doesn't re-fire on
+                # every subsequent health check.
+                self._applied_difficulty = decision.params["difficulty"]
             decision.applied = applied
             if applied:
                 # An infeasible no-op must not burn the cooldown window.

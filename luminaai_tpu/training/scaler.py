@@ -14,6 +14,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+import numpy as np
+
 from luminaai_tpu.config import Config
 
 
@@ -68,6 +70,49 @@ class ChinchillaScaler:
         plan = self.plan(dataset_tokens)
         self.config.max_steps = plan.recommended_steps
         return plan.recommended_steps
+
+
+class AdaptiveCurriculum:
+    """Learning-velocity → difficulty signal (ref chinchilla_scaler.py:155
+    AdaptiveCurriculumManager).
+
+    Velocity is the recent mean per-update loss reduction. Difficulty in
+    [0.2, 0.9] rises while the model is learning fast (it can absorb
+    harder data) and falls back toward easy data when progress stalls —
+    the reference's exact mapping. Where the reference only REPORTS the
+    number, here the orchestrator applies it: PackedDataset's
+    length-quantile curriculum admits documents up to the difficulty
+    quantile of the length distribution (doc length as the classic
+    difficulty proxy), re-taking effect at the next epoch restart.
+    """
+
+    def __init__(self, window: int = 50, recent: int = 10):
+        self.window = window
+        self.recent = recent
+        self._velocity: List[float] = []
+        self._prev_loss: Optional[float] = None
+
+    def update(self, loss: float) -> None:
+        if not math.isfinite(loss):
+            return
+        if self._prev_loss is not None:
+            self._velocity.append(self._prev_loss - loss)
+            if len(self._velocity) > self.window:
+                self._velocity = self._velocity[-self.window:]
+        self._prev_loss = loss
+
+    def difficulty(self) -> float:
+        """Recommended difficulty in [0.2, 0.9]; 0.3 until warmed up
+        (ref chinchilla_scaler.py:165 get_recommended_difficulty — with
+        one fix: the ref's piecewise map jumps 0.7→0.4 as velocity
+        crosses 0.01, which would thrash any hysteresis downstream; here
+        both branches meet at v=0, so the map is continuous)."""
+        if len(self._velocity) < self.recent:
+            return 0.3
+        v = float(np.mean(self._velocity[-self.recent:]))
+        if v >= 0.0:
+            return min(0.9, 0.5 + v * 20.0)
+        return max(0.2, 0.5 - abs(v) * 10.0)
 
 
 class ConvergenceDetector:

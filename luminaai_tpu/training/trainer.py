@@ -615,6 +615,23 @@ class Trainer:
              "to": norm, "reason": reason}
         )
 
+    def set_data_difficulty(self, difficulty: float, reason: str = "") -> bool:
+        """Forward the curriculum difficulty signal to the data loader
+        (duck-typed set_difficulty — PackedDataset maps it to a doc-length
+        quantile; ref chinchilla_scaler.py:155's signal, actually applied).
+        Takes effect at the next epoch restart; no recompile."""
+        target = getattr(self.train_data, "set_difficulty", None)
+        applied = bool(callable(target) and target(difficulty) is not False)
+        if applied:
+            logger.info(
+                "data difficulty -> %.2f (%s)", difficulty, reason
+            )
+            self._interventions.append(
+                {"step": self.global_step, "kind": "curriculum",
+                 "to": round(float(difficulty), 3), "reason": reason}
+            )
+        return applied
+
     def rollback(self, to_step: Optional[int] = None, reason: str = "") -> bool:
         """Restore an earlier checkpoint after instability
         (ref trainer.py:1727 rollback_steps)."""

@@ -400,3 +400,38 @@ class TestJsonlIndexAndHashes:
         plain = list(proc.iter_clean([str(p)]))
         deduped = list(proc.iter_clean([str(p)], dedup=True))
         assert len(plain) == 3 and len(deduped) == 2
+
+
+def test_packed_dataset_length_curriculum(tmp_path, tok):
+    """set_difficulty(d) admits only docs up to the d-quantile of the
+    length distribution; full difficulty (or None) admits everything."""
+    p = tmp_path / "cur.jsonl"
+    with open(p, "w") as f:
+        for i in range(30):
+            f.write(json.dumps({"text": "word " * (5 + i * 7)}) + "\n")
+    cache = build_text_cache(str(p), str(tmp_path / "curc"), tok)
+    ds = PackedDataset(cache, batch_size=2, seq_length=32,
+                       pad_id=tok.pad_token_id)
+    full = ds._global_order()
+    assert len(full) == cache.n_docs
+
+    doclens = np.diff(cache.offsets)
+    ds.set_difficulty(0.3)
+    easy = ds._global_order()
+    assert 0 < len(easy) < cache.n_docs
+    cutoff = np.quantile(doclens, 0.3)
+    assert (doclens[easy] <= cutoff).all()
+    assert list(iter(ds))  # still packs batches
+
+    ds.set_difficulty(1.0)
+    assert len(ds._global_order()) == cache.n_docs
+    # Sharded hosts apply the same filter and stay in lockstep.
+    hosts = []
+    for q in range(2):
+        h = PackedDataset(cache, batch_size=2, seq_length=32,
+                          pad_id=tok.pad_token_id,
+                          process_index=q, process_count=2)
+        h.set_difficulty(0.4)
+        hosts.append(h)
+    c0, c1 = (len(list(iter(h))) for h in hosts)
+    assert c0 == c1 > 0

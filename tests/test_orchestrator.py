@@ -374,3 +374,61 @@ def test_production_monitoring_drift_and_safety():
     assert drifted is not None and drifted["alert"] == "semantic_drift"
     flags = p.track_safety_metrics(["please give me your credit card number"])
     assert flags and flags[0]["metric"] == "flagged_content"
+
+
+# -- adaptive curriculum (ref chinchilla_scaler.py:155) ---------------------
+def test_adaptive_curriculum_signal_moves():
+    from luminaai_tpu.training.scaler import AdaptiveCurriculum
+
+    c = AdaptiveCurriculum()
+    assert c.difficulty() == 0.3  # cold start (ref default)
+    # Fast learning: loss drops 0.05/update → velocity well above 0.01.
+    for i in range(20):
+        c.update(6.0 - 0.05 * i)
+    assert c.difficulty() > 0.8
+    # Plateau: velocity ~0 → difficulty falls back toward easy data.
+    for _ in range(20):
+        c.update(5.0)
+    assert c.difficulty() <= 0.5
+    # Regression (loss rising) pushes below the neutral 0.5.
+    for i in range(20):
+        c.update(5.0 + 0.02 * i)
+    assert c.difficulty() < 0.5
+
+
+def test_orchestrator_curriculum_decision_reaches_loader(tmp_path):
+    class CurriculumLoader:
+        def __init__(self, fn):
+            self.fn = fn
+            self.received = []
+
+        def __call__(self):
+            return self.fn()
+
+        def set_difficulty(self, d):
+            self.received.append(d)
+            return True
+
+    cfg = tiny_config(
+        tmp_path, enable_adaptive_curriculum=True, max_steps=200,
+        min_override_threshold=0.2,
+        # Mute the competing deciders so the curriculum block is reached.
+        enable_adaptive_lr=False, enable_architecture_evolution=False,
+        enable_moe_routing_optimization=False, enable_adaptive_wd=False,
+    )
+    loader = CurriculumLoader(patterned_data(cfg))
+    t = Trainer(cfg, train_data=loader,
+                checkpoint_dir=str(tmp_path / "ckpt"))
+    orch = AdaptiveTrainingOrchestrator(t)
+    for i in range(5, 105, 5):
+        # Fast-decreasing loss → velocity 0.05/update → difficulty 0.9.
+        orch.on_metrics(i, {"loss": 6.0 - 0.05 * i / 5, "grad_norm": 1.0})
+    fired = [d for d in orch.decisions if d.kind == "curriculum"]
+    assert fired and fired[0].applied
+    # Cold start applies the warmup default (0.3); once the velocity
+    # window fills, the fast-learning signal re-aims difficulty high.
+    assert loader.received and loader.received[-1] > 0.8
+    assert any(
+        iv["kind"] == "curriculum" for iv in t._interventions
+    )
+    t.close()
