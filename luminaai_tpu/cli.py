@@ -800,9 +800,26 @@ def cmd_diagnose(args) -> int:
         check_config_fits,
         format_diagnostics,
         recommend_preset,
+        tpu_runtime_diagnostics,
     )
 
-    print(format_diagnostics())
+    # Runtime probes FIRST (ref cuda_debug_script.py's role): reachability
+    # via a subprocess matmul with a hard timeout — initializing a dead
+    # tunnel in-process would hang this very tool, so jax is only touched
+    # here after the probe answers ok.
+    rt = tpu_runtime_diagnostics(
+        probe_timeout=getattr(args, "probe_timeout", 90)
+    )
+    print(format_diagnostics(
+        include_accelerator=rt["backend"]["status"] == "ok"
+    ))
+    print("[runtime]")
+    for section, vals in rt.items():
+        print(f"  {section}:")
+        for k, v in vals.items():
+            print(f"    {k}: {v}")
+    if rt["backend"]["status"] != "ok":
+        return 1
     try:
         print(f"recommended preset for this fleet: {recommend_preset()}")
         if args.preset:
@@ -1058,6 +1075,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     g = sub.add_parser("diagnose", help="system diagnostics")
     g.add_argument("--preset", help="also check whether PRESET fits")
+    g.add_argument("--probe-timeout", type=int, default=90,
+                   help="seconds before the backend probe is declared hung")
     g.set_defaults(fn=cmd_diagnose)
 
     s = sub.add_parser("presets", help="list model presets")

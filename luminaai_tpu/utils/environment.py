@@ -174,14 +174,142 @@ def recommend_preset(n_devices: Optional[int] = None) -> str:
     return best
 
 
-def format_diagnostics() -> str:
+_PROBE_CODE = """
+import json, time
+import jax, jax.numpy as jnp
+t0 = time.perf_counter()
+x = jnp.ones((512, 512), jnp.bfloat16)
+float((x @ x).sum())
+cold = time.perf_counter() - t0
+t0 = time.perf_counter()
+float((x @ x).sum())
+warm = time.perf_counter() - t0
+d = jax.devices()[0]
+try:
+    stats = d.memory_stats() or {}
+except Exception:
+    stats = {}
+print(json.dumps({
+    "platform": d.platform,
+    "devices": jax.device_count(),
+    "device_kind": getattr(d, "device_kind", "unknown"),
+    "cold_matmul_s": round(cold, 2),
+    "warm_matmul_s": round(warm, 4),
+    "hbm_in_use_gb": (
+        round(stats["bytes_in_use"] / 1e9, 3)
+        if "bytes_in_use" in stats else None
+    ),
+    "hbm_limit_gb": (
+        round(stats["bytes_limit"] / 1e9, 2)
+        if "bytes_limit" in stats else None
+    ),
+}))
+"""
+
+
+def tpu_runtime_diagnostics(probe_timeout: int = 90) -> Dict[str, Any]:
+    """Runtime probes for `cli diagnose` — the TPU counterpart of the
+    reference's cuda_debug_script.py allocator/kernel diagnosis.
+
+    Three findings an operator keeps rediscovering by hand here:
+      - backend reachability, via a REAL matmul in a subprocess with a
+        hard timeout (a dead tunnel HANGS rather than erroring, so an
+        in-process probe would wedge the diagnosing tool itself);
+      - HBM occupancy/limit from live memory_stats;
+      - persistent XLA compile-cache state (entries, size, freshness —
+        a cold cache explains a 'slow first step' report).
+    """
+    import glob
+    import json as _json
+    import subprocess
+    import time as _time
+
+    out: Dict[str, Any] = {}
+    t0 = _time.monotonic()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_CODE],
+            capture_output=True,
+            text=True,
+            timeout=probe_timeout,
+        )
+        dt = round(_time.monotonic() - t0, 1)
+        if proc.returncode == 0:
+            try:
+                probe = _json.loads(proc.stdout.strip().splitlines()[-1])
+            except (ValueError, IndexError):
+                probe = {"raw": proc.stdout[-200:]}
+            out["backend"] = {
+                "status": "ok", "probe_seconds": dt, **probe,
+            }
+        else:
+            err = (proc.stderr or "").strip().splitlines()
+            out["backend"] = {
+                "status": "error",
+                "probe_seconds": dt,
+                "last_error": err[-1][-200:] if err else f"rc={proc.returncode}",
+            }
+    except subprocess.TimeoutExpired:
+        out["backend"] = {
+            "status": "hung",
+            "probe_seconds": probe_timeout,
+            "hint": (
+                "probe hung past the timeout — the dead-tunnel signature "
+                "(a configured-but-unreachable TPU backend hangs on init); "
+                "retry later or force CPU with PYTHONPATH= JAX_PLATFORMS=cpu"
+            ),
+        }
+
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if not cache_dir:
+        # bench/sweep processes share this repo-local cache (bench_common).
+        candidate = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)
+            ))),
+            ".jax_cache",
+        )
+        cache_dir = candidate if os.path.isdir(candidate) else None
+    if cache_dir and os.path.isdir(cache_dir):
+        entries = glob.glob(os.path.join(cache_dir, "*"))
+        out["compile_cache"] = {
+            "dir": cache_dir,
+            "entries": len(entries),
+            "total_mb": round(
+                sum(os.path.getsize(e) for e in entries if os.path.isfile(e))
+                / 1e6, 1,
+            ),
+            "newest_age_s": (
+                round(_time.time() - max(os.path.getmtime(e) for e in entries))
+                if entries else None
+            ),
+        }
+    else:
+        out["compile_cache"] = {
+            "dir": None,
+            "note": "no persistent compile cache configured "
+                    "(set JAX_COMPILATION_CACHE_DIR)",
+        }
+    return out
+
+
+def format_diagnostics(include_accelerator: bool = True) -> str:
     """Human-readable diagnostics block (ref Main.py:619
-    print_system_diagnostics)."""
+    print_system_diagnostics).
+
+    include_accelerator=False skips every jax touch: initializing a
+    configured-but-unreachable TPU backend HANGS in-process, so callers
+    that have just probed the backend as dead (cli diagnose) must be able
+    to print host facts without wedging."""
     lines: List[str] = ["=" * 64, "SYSTEM DIAGNOSTICS", "=" * 64]
     sysinfo = get_system_info()
     lines.append("[host]")
     for k, v in sysinfo.items():
         lines.append(f"  {k}: {v}")
+    if not include_accelerator:
+        lines.append("[accelerator] skipped: backend probe did not answer")
+        lines.append("=" * 64)
+        return "\n".join(lines)
     try:
         dev = get_device_info()
         lines.append("[accelerator]")

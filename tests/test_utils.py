@@ -123,3 +123,32 @@ def test_trainer_profile_window(tmp_path):
     t.close()
     profile_dir = Path(cfg.output_dir) / "profile"
     assert profile_dir.exists() and any(profile_dir.rglob("*"))
+
+
+def test_tpu_runtime_diagnostics_cpu_backend():
+    """Probe runs a real matmul in a subprocess (CPU here), reports
+    status/timings, and inspects the compile-cache state."""
+    from luminaai_tpu.utils.environment import tpu_runtime_diagnostics
+
+    rt = tpu_runtime_diagnostics(probe_timeout=120)
+    assert rt["backend"]["status"] == "ok", rt
+    assert rt["backend"]["platform"] == "cpu"
+    assert rt["backend"]["devices"] >= 1
+    assert rt["backend"]["cold_matmul_s"] >= 0
+    assert "compile_cache" in rt
+
+
+def test_tpu_runtime_diagnostics_hung_probe(monkeypatch):
+    """A wedged backend (dead-tunnel signature) is reported as hung with
+    the recovery hint, not by hanging the diagnosing tool."""
+    import subprocess as sp
+
+    from luminaai_tpu.utils import environment
+
+    def fake_run(*a, timeout=None, **k):
+        raise sp.TimeoutExpired(a[0], timeout)
+
+    monkeypatch.setattr(sp, "run", fake_run)
+    rt = environment.tpu_runtime_diagnostics(probe_timeout=5)
+    assert rt["backend"]["status"] == "hung"
+    assert "tunnel" in rt["backend"]["hint"]
