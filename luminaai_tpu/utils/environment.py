@@ -271,17 +271,22 @@ def tpu_runtime_diagnostics(probe_timeout: int = 90) -> Dict[str, Any]:
         )
         cache_dir = candidate if os.path.isdir(candidate) else None
     if cache_dir and os.path.isdir(cache_dir):
-        entries = glob.glob(os.path.join(cache_dir, "*"))
+        # Stat each entry once, tolerating concurrent eviction (bench/
+        # sweep processes share this dir and JAX rewrites entries).
+        sizes, mtimes = [], []
+        for e in glob.glob(os.path.join(cache_dir, "*")):
+            try:
+                st = os.stat(e)
+            except OSError:
+                continue
+            sizes.append(st.st_size)
+            mtimes.append(st.st_mtime)
         out["compile_cache"] = {
             "dir": cache_dir,
-            "entries": len(entries),
-            "total_mb": round(
-                sum(os.path.getsize(e) for e in entries if os.path.isfile(e))
-                / 1e6, 1,
-            ),
+            "entries": len(sizes),
+            "total_mb": round(sum(sizes) / 1e6, 1),
             "newest_age_s": (
-                round(_time.time() - max(os.path.getmtime(e) for e in entries))
-                if entries else None
+                round(_time.time() - max(mtimes)) if mtimes else None
             ),
         }
     else:

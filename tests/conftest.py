@@ -11,6 +11,13 @@ import os
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
+# Child processes spawned by tests (backend probes, dryrun workers, bench
+# children) must ALSO land on CPU: they re-run the container sitecustomize
+# from PYTHONPATH, which pins the tunneled TPU backend and can HANG a
+# probe against a dead tunnel. Normalize the inheritable env here — the
+# in-process jax.config.update below doesn't reach subprocesses.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PYTHONPATH", None)
 
 import jax
 
