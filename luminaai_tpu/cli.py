@@ -312,6 +312,7 @@ def cmd_chat(args) -> int:
         checkpoint_dir=args.checkpoint,
         quantize=getattr(args, "quantize", None),
         adapter=getattr(args, "adapter", None),
+        kv_cache_dtype=getattr(args, "kv_cache_dtype", None),
     )
     if chat.engine.quantization_info:
         q = chat.engine.quantization_info
@@ -606,6 +607,7 @@ def cmd_serve(args) -> int:
         bootstrap_user=bootstrap,
         quantize=getattr(args, "quantize", None),
         adapter=getattr(args, "adapter", None),
+        kv_cache_dtype=getattr(args, "kv_cache_dtype", None),
     )
     return 0
 
@@ -994,6 +996,8 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--password")
     c.add_argument("--quantize", choices=["int8", "int4"],
                    help="weight-only quantization for serving")
+    c.add_argument("--kv-cache-dtype", choices=["bf16", "int8"],
+                   help="decode KV cache storage (int8 halves cache HBM)")
     c.add_argument("--adapter",
                    help="LoRA adapter (.npz from finetune) merged at load")
     c.set_defaults(fn=cmd_chat)
@@ -1024,6 +1028,8 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--user", help="bootstrap user (secure mode)")
     sv.add_argument("--password", help="bootstrap password (secure mode)")
     sv.add_argument("--quantize", choices=["int8", "int4"])
+    sv.add_argument("--kv-cache-dtype", choices=["bf16", "int8"],
+                    help="decode KV cache storage (int8 halves cache HBM)")
     sv.add_argument("--adapter", help="LoRA adapter merged at load")
     sv.set_defaults(fn=cmd_serve)
 

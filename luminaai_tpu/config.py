@@ -57,6 +57,12 @@ class Config:
     # 'bf16' (rotation in the compute dtype; inputs/outputs are bf16-
     # quantized either way, only the products round differently).
     rope_dtype: str = "fp32"
+    # Decode KV cache storage: 'bf16' (compute dtype) or 'int8' (per
+    # position/head symmetric codes + fp32 scales — halves cache HBM, so
+    # max batch·context doubles; the dequant convert fuses into the
+    # attention dots). Quantization happens at insert; prefill/decode
+    # math is otherwise unchanged.
+    kv_cache_dtype: str = "bf16"
     # Sliding-window (local) attention: each position attends to at most
     # the `attention_window` most recent positions (itself included).
     # None = full causal. The flash kernels skip whole blocks outside the
@@ -350,6 +356,9 @@ class Config:
         assert self.precision in PRECISIONS, f"invalid precision {self.precision}"
         assert self.rope_dtype in ("fp32", "bf16"), (
             f"invalid rope_dtype {self.rope_dtype}"
+        )
+        assert self.kv_cache_dtype in ("bf16", "int8"), (
+            f"invalid kv_cache_dtype {self.kv_cache_dtype}"
         )
         if self.attention_window is not None:
             assert self.attention_window > 0, (

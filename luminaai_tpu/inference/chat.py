@@ -169,6 +169,7 @@ class ChatInterface:
         engine: Optional[GenerationEngine] = None,
         quantize: Optional[str] = None,
         adapter: Optional[str] = None,
+        kv_cache_dtype: Optional[str] = None,
     ):
         if engine is not None:
             self.engine = engine
@@ -204,6 +205,10 @@ class ChatInterface:
                 # Serve int8/int4 weight-only (the engine applies it from
                 # config; ref trainer.py:575 QuantizationManager).
                 config.quantization_method = quantize
+            if kv_cache_dtype is not None:
+                # int8 decode KV cache: half the cache HBM, so max
+                # batch·context doubles (config.kv_cache_dtype).
+                config.kv_cache_dtype = kv_cache_dtype
             self.config = config
             # The checkpoint's tokenizer_name travels in its config
             # metadata; decoding with anything else (e.g. forcing byte for

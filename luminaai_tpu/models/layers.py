@@ -281,11 +281,12 @@ class GQAttention(nn.Module):
 
         # Runtime length can exceed cfg.seq_length (soft-prompt prefixes
         # prepend virtual tokens); the rope table covers whichever is larger.
-        max_len = (
-            kv_cache[0].shape[1]
-            if kv_cache is not None
-            else max(cfg.seq_length, S)
-        )
+        if kv_cache is not None:
+            ck0 = kv_cache[0]
+            # int8 caches are (codes, scales) pairs; bf16 are plain arrays.
+            max_len = (ck0[0] if isinstance(ck0, tuple) else ck0).shape[1]
+        else:
+            max_len = max(cfg.seq_length, S)
         cos, sin = rope_frequencies(d, max_len, cfg.rope_theta)
         rope_ct = self.dtype if cfg.rope_dtype == "bf16" else jnp.float32
         q = apply_rope(q, cos, sin, positions, compute_dtype=rope_ct)
@@ -294,9 +295,38 @@ class GQAttention(nn.Module):
         new_cache = None
         if kv_cache is not None:
             ck, cv = kv_cache
-            ck = jax.lax.dynamic_update_slice(ck, k, (0, cache_index, 0, 0))
-            cv = jax.lax.dynamic_update_slice(cv, v, (0, cache_index, 0, 0))
-            k, v = ck, cv
+            if isinstance(ck, tuple):
+                # int8 KV cache (config.kv_cache_dtype='int8'): codes +
+                # per-row scales. Quantize the fresh rows at insert; read
+                # back the whole cache dequantized — XLA fuses the
+                # convert-multiply into the attention dots, so the HBM
+                # read is the int8 codes, not a rebuilt bf16 array.
+                from luminaai_tpu.ops.quantized import quantize_act
+
+                def _upd(cache, fresh):
+                    codes, scales = cache
+                    q8, s = quantize_act(fresh)
+                    codes = jax.lax.dynamic_update_slice(
+                        codes, q8, (0, cache_index, 0, 0)
+                    )
+                    scales = jax.lax.dynamic_update_slice(
+                        scales, s, (0, cache_index, 0, 0)
+                    )
+                    deq = (codes.astype(jnp.float32) * scales).astype(
+                        self.dtype
+                    )
+                    return (codes, scales), deq
+
+                ck, k = _upd(ck, k)
+                cv, v = _upd(cv, v)
+            else:
+                ck = jax.lax.dynamic_update_slice(
+                    ck, k, (0, cache_index, 0, 0)
+                )
+                cv = jax.lax.dynamic_update_slice(
+                    cv, v, (0, cache_index, 0, 0)
+                )
+                k, v = ck, cv
             new_cache = (ck, cv)
 
         q = nn.with_logical_constraint(

@@ -428,11 +428,18 @@ class LuminaTransformer(nn.Module):
         d = cfg.head_dim()
         shape = (batch_size, max_len, cfg.num_kv_heads, d)
 
+        def one(lead):
+            if cfg.kv_cache_dtype == "int8":
+                # (codes, per-row scales): half the HBM of a bf16 cache,
+                # so max batch·context doubles (see config.kv_cache_dtype).
+                return (
+                    jnp.zeros((*lead, *shape), dtype=jnp.int8),
+                    jnp.ones((*lead, *shape[:-1], 1), dtype=jnp.float32),
+                )
+            return jnp.zeros((*lead, *shape), dtype=self.dtype)
+
         def pair(*lead):
-            return (
-                jnp.zeros((*lead, *shape), dtype=self.dtype),
-                jnp.zeros((*lead, *shape), dtype=self.dtype),
-            )
+            return (one(lead), one(lead))
 
         if cfg.scan_layers:
             return [

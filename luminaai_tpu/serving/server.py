@@ -357,12 +357,14 @@ def serve(
     bootstrap_user: Optional[tuple] = None,
     quantize: Optional[str] = None,
     adapter: Optional[str] = None,
+    kv_cache_dtype: Optional[str] = None,
 ):
     """Build an engine from a checkpoint and serve it (CLI `serve`)."""
     from luminaai_tpu.inference.chat import ChatInterface
 
     chat = ChatInterface(
-        checkpoint_dir=checkpoint, quantize=quantize, adapter=adapter
+        checkpoint_dir=checkpoint, quantize=quantize, adapter=adapter,
+        kv_cache_dtype=kv_cache_dtype
     )
     ChatServer(
         chat.engine, secure=secure, bootstrap_user=bootstrap_user
