@@ -171,6 +171,46 @@ def bench_loss(B=8, S=2048, H=1024, V=32768) -> List[Dict]:
     return rows
 
 
+def bench_int8_matmul(M=256, K=1024, N=32768) -> List[Dict]:
+    """bf16 vs W8A8 int8 at the decode vocab-projection shape — the MXU
+    int8-peak claim (v5e ~2x bf16) measured directly, plus the full
+    quantized projection (dynamic act quant included) as served."""
+    import jax
+    import jax.numpy as jnp
+
+    from luminaai_tpu.ops.quantized import int8_attend, quantize_array
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(M, K) * 0.02, jnp.bfloat16)
+    w = jnp.asarray(rng.randn(N, K) * 0.02, jnp.float32)
+    qt = quantize_array(w, bits=8, axis=(-1,))
+    x8 = jnp.asarray(rng.randint(-127, 128, (M, K)), jnp.int8)
+
+    def bf16(x, wbf):
+        return jax.lax.dot_general(
+            x, wbf, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    def raw_int8(a, b):
+        return jax.lax.dot_general(
+            a, b, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+
+    shape = f"M{M}xK{K}xN{N}"
+    return [
+        {"op": "matmul_bf16", "ms": _time_fn(
+            jax.jit(bf16), x, w.astype(jnp.bfloat16)) * 1e3,
+         "shape": shape},
+        {"op": "matmul_int8_raw", "ms": _time_fn(
+            jax.jit(raw_int8), x8, qt.q) * 1e3, "shape": shape},
+        {"op": "matmul_int8_attend_full", "ms": _time_fn(
+            jax.jit(lambda xx: int8_attend(xx, qt, jnp.float32)), x) * 1e3,
+         "shape": shape},
+    ]
+
+
 def _run_suite(suite: str, small: bool) -> List[Dict]:
     if suite == "attention":
         return bench_attention(**(dict(B=1, S=256, Hq=4, Hkv=2, D=64)
@@ -178,6 +218,9 @@ def _run_suite(suite: str, small: bool) -> List[Dict]:
     if suite == "moe":
         return bench_moe_dispatch(**(dict(G=2, S=256, H=128, F=256)
                                      if small else {}))
+    if suite == "int8":
+        return bench_int8_matmul(**(dict(M=32, K=128, N=2048)
+                                    if small else {}))
     return bench_loss(**(dict(B=2, S=256, H=128, V=2048) if small else {}))
 
 
@@ -196,7 +239,8 @@ def main() -> None:
     (same robustness contract as bench.py)."""
     parser = argparse.ArgumentParser()
     parser.add_argument(
-        "--suite", default="all", choices=["all", "attention", "moe", "loss"]
+        "--suite", default="all",
+        choices=["all", "attention", "moe", "loss", "int8"],
     )
     parser.add_argument("--small", action="store_true",
                         help="CPU-sized shapes for smoke testing")
@@ -205,7 +249,8 @@ def main() -> None:
     args = parser.parse_args()
 
     suites = (
-        ["attention", "moe", "loss"] if args.suite == "all" else [args.suite]
+        ["attention", "moe", "loss", "int8"]
+        if args.suite == "all" else [args.suite]
     )
     rows: List[Dict] = []
     platform = None

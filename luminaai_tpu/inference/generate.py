@@ -135,7 +135,12 @@ class GenerationEngine:
 
     def _make_prefill_fn(self, prompt_bucket: int):
         def prefill(params, ids, length):
-            caches = self.model.init_cache(1, self.max_context)
+            # The ENGINE's config decides cache storage, so serving-time
+            # overrides work regardless of which config built the model.
+            caches = self.model.init_cache(
+                1, self.max_context,
+                kv_cache_dtype=getattr(self.config, "kv_cache_dtype", None),
+            )
             positions = jnp.arange(prompt_bucket)[None, :]
             logits, caches, _ = self.model.apply(
                 {"params": params},

@@ -420,16 +420,24 @@ class LuminaTransformer(nn.Module):
         return out
 
     # -- decode cache (ref Chat.py:346 GenerationEngine cache handling) ----
-    def init_cache(self, batch_size: int, max_len: int):
+    def init_cache(
+        self, batch_size: int, max_len: int, kv_cache_dtype: str = None
+    ):
         """Preallocated KV caches, shaped to match the layer-stack layout:
         per-layer pairs normally; per-segment stacked pairs under
-        scan_layers (opaque to the generation engine either way)."""
+        scan_layers (opaque to the generation engine either way).
+
+        kv_cache_dtype overrides the model config's choice — the
+        generation engine passes ITS config so a serving-time override
+        (e.g. chat --kv-cache-dtype) doesn't depend on the model having
+        been built from the same mutable Config object."""
         cfg = self.config
+        choice = kv_cache_dtype or cfg.kv_cache_dtype
         d = cfg.head_dim()
         shape = (batch_size, max_len, cfg.num_kv_heads, d)
 
         def one(lead):
-            if cfg.kv_cache_dtype == "int8":
+            if choice == "int8":
                 # (codes, per-row scales): half the HBM of a bf16 cache,
                 # so max batch·context doubles (see config.kv_cache_dtype).
                 return (

@@ -252,14 +252,15 @@ def test_int8_kv_cache_decode_parity(setup, scan):
         qengine = GenerationEngine(qmodel, qparams, tok, qcfg)
     else:
         bengine = engine
-        # init_cache reads the MODEL's config (as ChatInterface's flow
-        # does, where the same Config object is mutated pre-engine).
-        qengine = GenerationEngine(
-            LuminaTransformer(qcfg), params, tok, qcfg
-        )
+        # The ENGINE's config governs cache storage — the shared model
+        # still carries the bf16 config, pinning that a serving-time
+        # override needs no model rebuild.
+        qengine = GenerationEngine(model, params, tok, qcfg)
 
     # Structure: codes int8 + fp32 scales, half the bf16 cache bytes.
-    caches = qengine.model.init_cache(1, 64)
+    caches = qengine.model.init_cache(
+        1, 64, kv_cache_dtype=qcfg.kv_cache_dtype
+    )
     leaves = jax.tree_util.tree_leaves(caches)
     assert any(l.dtype == jnp.int8 for l in leaves)
     code_b = sum(l.nbytes for l in leaves if l.dtype == jnp.int8)
