@@ -123,6 +123,17 @@ VARIANTS = {
         "remat_policy": "save_attn",
         "adam_state_quantization": "int8",
     },
+    # r4 candidate for flagship_tuned: every CPU-validated lever at once
+    # (ragged gmm dispatch, bf16 rope, flash-residual remat, big batch,
+    # int8 moments). If this wins on chip it becomes the tuned config.
+    "best_r4": {
+        "batch_size": 24,
+        "micro_batch_size": None,
+        "moe_dispatch": "gmm",
+        "remat_policy": "save_attn",
+        "adam_state_quantization": "int8",
+        "rope_dtype": "bf16",
+    },
 }
 
 names = sys.argv[1:] or ["base", "dots", "scan", "einsum"]

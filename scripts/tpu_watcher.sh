@@ -21,7 +21,7 @@ while true; do
     echo "$(date -u +%FT%TZ) bench.py first (headline artifact before anything can wedge)" >> scripts/sweep_out3.txt
     timeout -k 30 4200 python bench.py >> scripts/sweep_out3.txt 2>&1
     echo "$(date -u +%FT%TZ) bench.py rc=$?" >> scripts/sweep_out3.txt
-    timeout -k 30 6000 python scripts/perf_sweep.py attn gmm rope16 b24_q8_attn_gather rope16_gmm b24_q8_gmm_attn b32_q8_attn_gather attn_blk512 long8k long8k_win1k >> scripts/sweep_out3.txt 2>&1
+    timeout -k 30 6000 python scripts/perf_sweep.py attn best_r4 gmm rope16 b24_q8_attn_gather rope16_gmm b24_q8_gmm_attn b32_q8_attn_gather attn_blk512 long8k long8k_win1k >> scripts/sweep_out3.txt 2>&1
     echo "$(date -u +%FT%TZ) sweep rc=$?" >> scripts/sweep_out3.txt
     timeout -k 30 2400 python bench_ops.py >> scripts/sweep_out3.txt 2>&1
     echo "$(date -u +%FT%TZ) bench_ops rc=$?" >> scripts/sweep_out3.txt
