@@ -252,10 +252,12 @@ def _child_main(name: str) -> None:
 
     tokens = steps * cfg.batch_size * cfg.seq_length
     tps_chip = tokens / dt / n_chips
+    from luminaai_tpu.utils.environment import device_peak_flops
+
     tracker = ComputeEfficiencyTracker(
         active_params=cfg.estimate_active_parameters(),
         n_chips=n_chips,
-        peak_flops=TPU_PEAK_FLOPS,
+        peak_flops=device_peak_flops(jax.devices()[0], TPU_PEAK_FLOPS),
     )
     sample = tracker.record(tokens, dt)
     mfu = round(sample["mfu"], 4) if platform == "tpu" else None

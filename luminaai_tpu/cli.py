@@ -137,27 +137,27 @@ def make_data(cfg, args):
             "vocab_size to match", tokenizer.vocab_size, cfg.vocab_size,
         )
         cfg.vocab_size = tokenizer.vocab_size
+    # Per-host shard identity comes from config, not live jax state (the
+    # distributed runtime comes up later, in Trainer.__init__). On pods
+    # where jax auto-detects the process id, process_id is legitimately
+    # None — sharding on it would put EVERY host on shard 0, so fall back
+    # to the process-oblivious full-batch loader (Trainer._put slices
+    # each host's rows at runtime).
+    pi, pc = 0, 1
+    if cfg.multihost and (cfg.num_processes or 1) > 1:
+        if cfg.process_id is not None:
+            pi, pc = cfg.process_id, cfg.num_processes
+        else:
+            logger.warning(
+                "multihost without explicit process_id: data sharding "
+                "disabled; every host will read the full corpus (set "
+                "config.process_id to enable per-host shards)"
+            )
     if getattr(args, "packed", False):
         cache = build_text_cache(
             path, str(Path(cfg.output_dir) / "cache" / Path(path).stem),
             tokenizer,
         )
-        # Per-host shard identity comes from config, not live jax state
-        # (the distributed runtime comes up later, in Trainer.__init__).
-        # On pods where jax auto-detects the process id, process_id is
-        # legitimately None — sharding on it would put EVERY host on
-        # shard 0, so fall back to the process-oblivious full-batch
-        # loader (Trainer._put slices each host's rows at runtime).
-        pi, pc = 0, 1
-        if cfg.multihost and (cfg.num_processes or 1) > 1:
-            if cfg.process_id is not None:
-                pi, pc = cfg.process_id, cfg.num_processes
-            else:
-                logger.warning(
-                    "multihost without explicit process_id: data sharding "
-                    "disabled; every host will read the full corpus (set "
-                    "config.process_id to enable per-host shards)"
-                )
         ds = PackedDataset(
             cache, cfg.batch_size, cfg.seq_length,
             pad_id=tokenizer.pad_token_id, eos_id=tokenizer.eos_token_id,
@@ -188,7 +188,8 @@ def make_data(cfg, args):
         # batch order every epoch).
         epoch_counter["n"] += 1
         return conversation_batches(
-            ds, cfg.batch_size, seed=cfg.seed + epoch_counter["n"]
+            ds, cfg.batch_size, seed=cfg.seed + epoch_counter["n"],
+            process_index=pi, process_count=pc,
         )
 
     eval_fn = None
@@ -247,10 +248,16 @@ def cmd_train(args) -> int:
     if steps and not args.quiet:
         tok_per_step = cfg.batch_size * cfg.seq_length
         # ~40% MFU planning number on detected hardware; CPU ≈ debug only.
-        from luminaai_tpu.utils.environment import get_device_info
+        from luminaai_tpu.utils.environment import (
+            device_peak_flops,
+            get_device_info,
+        )
 
         dev = get_device_info()
-        peak = {"tpu": 197e12, "gpu": 312e12}.get(dev["platform"], 5e11)
+        if dev["platform"] == "tpu":
+            peak = device_peak_flops()
+        else:
+            peak = {"gpu": 312e12}.get(dev["platform"], 5e11)
         est_tps = max(
             1.0,
             0.4 * peak * dev["device_count"]

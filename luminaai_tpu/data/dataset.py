@@ -572,22 +572,69 @@ def conversation_batches(
     batch_size: int,
     seed: int = 0,
     drop_last: bool = True,
+    process_index: int = 0,
+    process_count: int = 1,
 ) -> Iterator[Dict[str, np.ndarray]]:
-    """Group per-conversation samples into [B, S] batches."""
+    """Group per-conversation samples into batches.
+
+    Multi-host: `batch_size` stays the GLOBAL batch; host p yields LOCAL
+    [batch_size/process_count, S] batches from its stride of the shared
+    shuffled order (Trainer._put assembles the global array). Batch
+    counts are capped identically on every host, so collectives stay in
+    lockstep. (Eager datasets still tokenize the full file on each host
+    at load; the per-host win here is batch assembly + transfer, matching
+    the ref's DistributedSampler granularity.)
+    """
+    if batch_size % process_count != 0:
+        raise ValueError(
+            f"global batch {batch_size} not divisible by process_count "
+            f"{process_count}"
+        )
+    if not drop_last and process_count > 1:
+        # Lockstep genuinely requires dropping the final partial round —
+        # honoring drop_last=False would desync host batch counts.
+        raise ValueError(
+            "drop_last=False is incompatible with multi-host sharding"
+        )
+    local = batch_size // process_count
     if dataset.streaming:
-        buf: List[Dict[str, np.ndarray]] = []
-        # Streaming epochs shuffle too, via the mmap'd line index.
+        if process_count == 1:
+            buf: List[Dict[str, np.ndarray]] = []
+            # Streaming epochs shuffle too, via the mmap'd line index.
+            for s in dataset.iter_samples(shuffle_seed=seed):
+                buf.append(s)
+                if len(buf) == batch_size:
+                    yield _stack(buf)
+                    buf = []
+            if buf and not drop_last:
+                yield _stack(buf)
+            return
+        # Multi-host streaming: no host knows the sample count up front,
+        # so lockstep is guaranteed by round-buffering one GLOBAL batch
+        # and yielding this host's rows — a round only counts when full,
+        # so every host yields the identical number of batches.
+        buf = []
         for s in dataset.iter_samples(shuffle_seed=seed):
             buf.append(s)
             if len(buf) == batch_size:
-                yield _stack(buf)
+                yield _stack(
+                    buf[process_index * local:(process_index + 1) * local]
+                )
                 buf = []
-        if buf and not drop_last:
-            yield _stack(buf)
         return
     idx = shuffle_indices(len(dataset), seed)
-    for i in range(0, len(idx) - batch_size + 1, batch_size):
-        yield _stack([dataset[int(j)] for j in idx[i:i + batch_size]])
+    if process_count == 1:
+        for i in range(0, len(idx) - batch_size + 1, batch_size):
+            yield _stack([dataset[int(j)] for j in idx[i:i + batch_size]])
+        return
+    # Shared order, per-host stride; the shortest shard (= len//pc, since
+    # strided shard sizes differ by <=1) caps every host at the same
+    # batch count.
+    shard = idx[process_index::process_count]
+    n_batches = len(idx) // process_count // local
+    for b in range(n_batches):
+        rows = shard[b * local:(b + 1) * local]
+        yield _stack([dataset[int(j)] for j in rows])
 
 
 def _stack(samples: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:

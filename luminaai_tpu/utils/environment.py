@@ -26,6 +26,40 @@ _TPU_HBM_GB = {
     "v6e": 32.0,
 }
 
+# Per-chip bf16 peak (FLOP/s) by generation — the MFU denominator.
+# Public figures: v4 275T, v5e 197T, v5p 459T, v6e (Trillium) 918T.
+_TPU_PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+}
+
+
+def _lookup_by_device_kind(kind: str, table: Dict[str, float], default):
+    """Substring match of a device_kind against a generation table —
+    shared by the HBM and peak-FLOPs lookups so they can't drift."""
+    kind = kind.lower()
+    for key, val in table.items():
+        if key in kind:
+            return val
+    return default
+
+
+def device_peak_flops(device=None, default: float = 197e12) -> float:
+    """bf16 peak FLOP/s for `device` (default: jax.devices()[0]) from the
+    generation table; `default` (v5e) when the kind is unknown. Keeps MFU
+    honest across chip generations instead of hardcoding one part."""
+    if device is None:
+        import jax
+
+        device = jax.devices()[0]
+    return _lookup_by_device_kind(
+        getattr(device, "device_kind", ""), _TPU_PEAK_FLOPS, default
+    )
+
 
 def get_system_info() -> Dict[str, Any]:
     """Host-side software/hardware summary (ref environment.py
@@ -68,11 +102,9 @@ def _device_memory_gb(device) -> Optional[float]:
             return round(stats["bytes_limit"] / 1e9, 2)
     except Exception:
         pass
-    kind = getattr(device, "device_kind", "").lower()
-    for key, gb in _TPU_HBM_GB.items():
-        if key in kind:
-            return gb
-    return None
+    return _lookup_by_device_kind(
+        getattr(device, "device_kind", ""), _TPU_HBM_GB, None
+    )
 
 
 def get_device_info() -> Dict[str, Any]:

@@ -435,3 +435,33 @@ def test_packed_dataset_length_curriculum(tmp_path, tok):
         hosts.append(h)
     c0, c1 = (len(list(iter(h))) for h in hosts)
     assert c0 == c1 > 0
+
+
+def test_conversation_batches_process_sharding(tmp_path, tok):
+    """Host shards of conversation batches: local rows, lockstep counts,
+    disjoint+exhaustive coverage of the global batch rows."""
+    p = tmp_path / "conv.jsonl"
+    write_conv_jsonl(p, n=21)  # 21 % 2 != 0: shard sizes differ by one
+    cfg = Config(vocab_size=tok.vocab_size, hidden_size=64, num_heads=4,
+                 num_kv_heads=2, seq_length=64, batch_size=4)
+    ds = ConversationDataset(str(p), tok, cfg)
+
+    full = list(conversation_batches(ds, 4, seed=3))
+    host = [
+        list(conversation_batches(ds, 4, seed=3,
+                                  process_index=q, process_count=2))
+        for q in range(2)
+    ]
+    assert len(host[0]) == len(host[1]) > 0  # lockstep despite 21 % 2
+    assert all(b["input_ids"].shape[0] == 2 for h in host for b in h)
+    # Shards are disjoint and cover the shared order: concatenating both
+    # hosts' rows reproduces a permutation of the full-batch rows.
+    def rows(batches):
+        return {bytes(r.tobytes()) for b in batches for r in b["input_ids"]}
+    r0, r1 = rows(host[0]), rows(host[1])
+    assert not (r0 & r1)
+    # Hosts jointly cover exactly the rows the single-host batches yield
+    # (same shared order, same lockstep truncation at 20 of 21 samples).
+    assert (r0 | r1) == rows(full)
+    with pytest.raises(ValueError, match="divisible"):
+        next(iter(conversation_batches(ds, 5, process_count=2)))

@@ -152,3 +152,17 @@ def test_tpu_runtime_diagnostics_hung_probe(monkeypatch):
     rt = environment.tpu_runtime_diagnostics(probe_timeout=5)
     assert rt["backend"]["status"] == "hung"
     assert "tunnel" in rt["backend"]["hint"]
+
+
+def test_device_peak_flops_table():
+    from luminaai_tpu.utils.environment import device_peak_flops
+
+    class D:
+        def __init__(self, kind):
+            self.device_kind = kind
+
+    assert device_peak_flops(D("TPU v5 lite")) == 197e12
+    assert device_peak_flops(D("TPU v5p")) == 459e12
+    assert device_peak_flops(D("TPU v6e")) == 918e12
+    assert device_peak_flops(D("cpu")) == 197e12  # unknown → default
+    assert device_peak_flops(D("cpu"), default=1.0) == 1.0
