@@ -33,6 +33,23 @@ import time
 REF_MOE_TOKENS_PER_SEC = 59_500.0
 METRIC = "train_tokens_per_sec_per_chip_moe8x2"
 
+# The reference's published throughput rows (its BENCHMARKS.md) that fit
+# one chip, matched dims-for-dims. The headline rung (ref_debug_moe) and
+# the DENSE_BENCH sidecar compare against two of these; the REF_TABLE
+# sidecar sweeps the rest so every debug-scale row has a measured
+# counterpart. (name -> (ref tok/s, rung timeout_s))
+REF_TABLE_RUNGS = {
+    "ref_debug_dense": (104_000.0, 420),   # "Debug" dense row
+    "ref_200m_dense": (119_000.0, 600),    # "Debug 200M" dense row
+    "ref_200m_mod": (172_000.0, 600),      # "Debug 200M" MoD cap 0.5 row
+    "ref_200m_hybrid": (139_000.0, 600),   # "Debug 200M" hybrid row
+}
+REF_BASELINES = {
+    "ref_debug_moe": REF_MOE_TOKENS_PER_SEC,
+    "dense200": 119_000.0,
+    **{k: v[0] for k, v in REF_TABLE_RUNGS.items()},
+}
+
 # TPU v5e bf16 peak per chip. Used for MFU; other platforms report mfu=null.
 TPU_PEAK_FLOPS = 197e12
 
@@ -116,6 +133,48 @@ def _child_config(name: str, n_chips: int = 1):
             use_flash_attention=True,
             gradient_checkpointing=True,
             **tuned,
+        )
+    if name == "ref_debug_dense":
+        # The reference's debug DENSE row (~104k tok/s): its debug preset
+        # dims (ref config_manager.py:763) with MoE off.
+        return Config(
+            vocab_size=1024,
+            hidden_size=128,
+            num_layers=2,
+            num_heads=2,
+            num_kv_heads=1,
+            seq_length=256,
+            intermediate_size=256,
+            batch_size=256 * n_chips,
+            use_moe=False,
+            precision="bf16",
+            use_flash_attention=True,
+            gradient_checkpointing=False,
+        )
+    if name in ("ref_200m_dense", "ref_200m_mod", "ref_200m_hybrid"):
+        # The reference's debug_200m dims (ref config_manager.py:946:
+        # vocab 1024, hidden 640, 12 layers, heads 8/8, seq 512,
+        # intermediate 2560) under its three published variants: dense
+        # (~119k), MoD cap 0.5 (~172k), hybrid MoE8+MoD (~139k).
+        return Config(
+            vocab_size=1024,
+            hidden_size=640,
+            num_layers=12,
+            num_heads=8,
+            num_kv_heads=8,
+            seq_length=512,
+            intermediate_size=2560,
+            batch_size=64 * n_chips,
+            use_moe=(name == "ref_200m_hybrid"),
+            num_experts=8,
+            moe_top_k=2,
+            capacity_factor=1.25,
+            load_balancing_weight=0.01,
+            use_mod=(name != "ref_200m_dense"),
+            mod_capacity_factor=0.5,
+            precision="bf16",
+            use_flash_attention=True,
+            gradient_checkpointing=False,
         )
     if name == "dense200":
         # ~200M dense comparison point (ref BENCHMARKS.md "200M dense
@@ -262,18 +321,17 @@ def _child_main(name: str) -> None:
     sample = tracker.record(tokens, dt)
     mfu = round(sample["mfu"], 4) if platform == "tpu" else None
 
+    sidecar_rung = name == "dense200" or name in REF_TABLE_RUNGS
     result = {
         "metric": (
-            "train_tokens_per_sec_per_chip_dense200"
-            if name == "dense200"
+            f"train_tokens_per_sec_per_chip_{name}"
+            if sidecar_rung
             else METRIC
         ),
         "value": round(tps_chip, 1),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(
-            tps_chip
-            / (119_000.0 if name == "dense200" else REF_MOE_TOKENS_PER_SEC),
-            3,
+            tps_chip / REF_BASELINES.get(name, REF_MOE_TOKENS_PER_SEC), 3
         ),
         "extras": {
             "chips": n_chips,
@@ -468,6 +526,47 @@ def main() -> None:
                         "w",
                     ) as f:
                         json.dump(dense, f, indent=2)
+                # Row-for-row sweep of the reference's published
+                # debug-scale table (dense, 200M dense/MoD/hybrid) —
+                # matched dims, each rung bounded, results in
+                # REF_TABLE.json. Runs last so a hang can only cost the
+                # table, never the headline or dense sidecar.
+                table = []
+                for rname, (ref_tps, rtimeout) in REF_TABLE_RUNGS.items():
+                    res, rdiag = _run_child(rname, rtimeout)
+                    if res is not None:
+                        table.append({
+                            "config": rname,
+                            "tokens_per_sec_per_chip": res["value"],
+                            "ref_tokens_per_sec": ref_tps,
+                            "vs_ref": res["vs_baseline"],
+                            "step_ms": res["extras"].get("step_ms"),
+                            "batch": res["extras"].get("batch"),
+                            "seq": res["extras"].get("seq"),
+                        })
+                    else:
+                        table.append(
+                            {"config": rname, "error": rdiag[-300:]}
+                        )
+                with open(
+                    os.path.join(
+                        os.path.dirname(os.path.abspath(__file__)),
+                        "REF_TABLE.json",
+                    ),
+                    "w",
+                ) as f:
+                    json.dump(
+                        {
+                            "note": (
+                                "matched-dims counterparts of the "
+                                "reference BENCHMARKS.md debug-scale "
+                                "rows, measured on this backend"
+                            ),
+                            "rows": table,
+                        },
+                        f,
+                        indent=2,
+                    )
             return
     print(
         json.dumps(

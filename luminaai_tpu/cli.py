@@ -741,8 +741,53 @@ def cmd_convert(args) -> int:
         unstack_params_from_scan,
     )
 
-    _, params, cfg = load_model_for_inference(args.checkpoint)
+    try:
+        _, params, cfg = load_model_for_inference(args.checkpoint)
+    except ValueError as e:
+        # e.g. an int8 serving export fed back into convert: quantizing
+        # quantized codes would write a silently-corrupt checkpoint.
+        print(str(e), file=sys.stderr)
+        return 1
     is_scanned = any(k.startswith("scan_") for k in params)
+    if args.to == "int8":
+        # Quantized serving export (ref trainer.py:681,712 GPTQ/quanto
+        # model saves): weights stored as int8 codes + scales in the
+        # serving compute layout — half the disk/load bytes; chat/serve
+        # load it directly with no re-quantization pass.
+        from luminaai_tpu.training.quantization import (
+            export_quantized_tree,
+            quantize_for_serving,
+        )
+
+        if is_scanned:
+            print("convert --to plain first (int8 export needs the "
+                  "per-layer layout)", file=sys.stderr)
+            return 1
+        qtree, info = quantize_for_serving(params)
+        plain, manifest = export_quantized_tree(qtree)
+        new_cfg = dc.replace(cfg, quantization_method=None)
+        out = Path(args.out).absolute()
+        out.mkdir(parents=True, exist_ok=True)
+        with ocp.CheckpointManager(out) as mngr:
+            mngr.save(
+                0,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardSave({"params": plain}),
+                    metadata=ocp.args.JsonSave(
+                        {"step": 0, "config": new_cfg.to_dict(),
+                         "converted_from": str(args.checkpoint),
+                         "quantization": {"manifest": manifest,
+                                          "info": info}}
+                    ),
+                ),
+            )
+            mngr.wait_until_finished()
+        print(
+            f"int8 serving export: {info['quantized_leaves']}/"
+            f"{info['total_leaves']} tensors quantized, "
+            f"{info['compression']:.2f}x smaller -> {out}"
+        )
+        return 0
     if args.to == "scan" and is_scanned:
         print("checkpoint is already in scanned layout", file=sys.stderr)
         return 1
@@ -1065,10 +1110,12 @@ def build_parser() -> argparse.ArgumentParser:
     d.set_defaults(fn=cmd_data)
 
     cv = sub.add_parser(
-        "convert", help="convert checkpoint layer layout (scan <-> plain)"
+        "convert",
+        help="convert checkpoint layout (scan <-> plain) or export an "
+             "int8-quantized serving checkpoint",
     )
     cv.add_argument("--checkpoint", required=True)
-    cv.add_argument("--to", choices=["scan", "plain"], required=True)
+    cv.add_argument("--to", choices=["scan", "plain", "int8"], required=True)
     cv.add_argument("--out", required=True)
     cv.set_defaults(fn=cmd_convert)
 

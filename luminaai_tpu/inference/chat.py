@@ -81,6 +81,7 @@ def load_model_for_inference(
     step: Optional[int] = None,
     config: Optional[Config] = None,
     keep_master_dtype: bool = False,
+    allow_quantized: bool = False,
 ):
     """Restore params (+config) from an orbax checkpoint dir.
 
@@ -126,20 +127,48 @@ def load_model_for_inference(
             args=ocp.args.Composite(state=ocp.args.StandardRestore(abstract)),
         )["state"]
         params = restored["params"]
+        meta = None
+        try:
+            meta = mngr.restore(
+                step,
+                args=ocp.args.Composite(metadata=ocp.args.JsonRestore()),
+            )["metadata"]
+        except Exception:
+            pass
         if config is None:
             try:
-                meta = mngr.restore(
-                    step,
-                    args=ocp.args.Composite(metadata=ocp.args.JsonRestore()),
-                )["metadata"]
+                if meta is None:
+                    raise FileNotFoundError("no checkpoint metadata")
                 saved = dict(meta.get("config", {}))
                 known = {f.name for f in dataclasses.fields(Config)}
                 config = Config(
                     **{k: v for k, v in saved.items() if k in known}
                 )
             except Exception:
-                logger.info("no config metadata; inferring from params")
+                # Metadata absent or incompatible with this Config
+                # version: degrade to shape inference, as before.
+                logger.info("no usable config metadata; inferring from params")
                 config = infer_config_from_params(params)
+    if meta is not None and "quantization" in meta:
+        if not allow_quantized:
+            raise ValueError(
+                f"{checkpoint_dir} is an int8 SERVING checkpoint "
+                "(convert --to int8); chat/serve load it, but this "
+                "operation needs full-precision weights — use the "
+                "source checkpoint instead"
+            )
+        # int8 serving export (cli convert --to int8): rebuild the
+        # QuantizedTensor leaves — the model's quantization-aware call
+        # sites consume them directly, no re-quantization pass.
+        from luminaai_tpu.training.quantization import import_quantized_tree
+
+        params = import_quantized_tree(
+            params, meta["quantization"]["manifest"]
+        )
+        logger.info(
+            "loaded int8 serving checkpoint (%d quantized tensors)",
+            len(meta["quantization"]["manifest"]),
+        )
     # Serving precision (config.inference_precision, 'auto' → bf16):
     # cast float weights down so the resident serving copy matches the
     # compute dtype instead of keeping fp32 masters around.
@@ -148,11 +177,16 @@ def load_model_for_inference(
     ):
         import jax.numpy as jnp
 
+        from luminaai_tpu.training.quantization import QuantizedTensor
+
         params = jax.tree.map(
             lambda x: x.astype(jnp.bfloat16)
-            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+            if not isinstance(x, QuantizedTensor)
+            and hasattr(x, "dtype")
+            and jnp.issubdtype(x.dtype, jnp.floating)
             else x,
             params,
+            is_leaf=lambda x: isinstance(x, QuantizedTensor),
         )
     model = LuminaTransformer(config)
     return model, params, config
@@ -184,7 +218,7 @@ class ChatInterface:
                 checkpoint_dir = str(found)
                 logger.info("auto-discovered checkpoint: %s", checkpoint_dir)
             model, params, config = load_model_for_inference(
-                checkpoint_dir, config=config
+                checkpoint_dir, config=config, allow_quantized=True
             )
             if adapter is not None:
                 # Serve base + LoRA merged (training/adapters.py; ref

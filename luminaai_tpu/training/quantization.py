@@ -142,6 +142,67 @@ def quantize_for_serving(
     return jax.tree_util.tree_unflatten(treedef, out), info
 
 
+def _path_str(path) -> str:
+    return "/".join(
+        p.key for p in path if isinstance(p, jax.tree_util.DictKey)
+    )
+
+
+def export_quantized_tree(qtree: Any) -> Tuple[Any, Dict[str, Any]]:
+    """Serializable form of a quantized param tree: each QuantizedTensor
+    becomes a {'q': codes, 'scale': scales} dict (checkpointable arrays),
+    with a manifest of static fields keyed by tree path — the saved-
+    artifact counterpart of the ref's GPTQ/quanto model exports (ref
+    trainer.py:681,712 save quantized models for serving)."""
+    manifest: Dict[str, Any] = {}
+    flat, treedef = jax.tree_util.tree_flatten_with_path(
+        qtree, is_leaf=lambda x: isinstance(x, QuantizedTensor)
+    )
+    out = []
+    for path, leaf in flat:
+        if isinstance(leaf, QuantizedTensor):
+            manifest[_path_str(path)] = {
+                "bits": leaf.bits,
+                "axis": (
+                    list(leaf.axis)
+                    if isinstance(leaf.axis, tuple) else leaf.axis
+                ),
+                "orig_shape": list(leaf.orig_shape),
+            }
+            out.append({"q": leaf.q, "scale": leaf.scale})
+        else:
+            out.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out), manifest
+
+
+def import_quantized_tree(plain: Any, manifest: Dict[str, Any]) -> Any:
+    """Inverse of export_quantized_tree: rebuild QuantizedTensor leaves
+    from their {'q','scale'} dicts using the manifest's static fields."""
+
+    def is_q(path):
+        return _path_str(path) in manifest
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(
+        plain,
+        is_leaf=lambda x: isinstance(x, dict) and set(x) == {"q", "scale"},
+    )
+    out = []
+    for path, leaf in flat:
+        if isinstance(leaf, dict) and set(leaf) == {"q", "scale"} and is_q(path):
+            m = manifest[_path_str(path)]
+            axis = m["axis"]
+            out.append(QuantizedTensor(
+                q=leaf["q"],
+                scale=leaf["scale"],
+                bits=int(m["bits"]),
+                axis=tuple(axis) if isinstance(axis, list) else int(axis),
+                orig_shape=tuple(m["orig_shape"]),
+            ))
+        else:
+            out.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 def dequantize_tree(qparams: Any, dtype=jnp.bfloat16) -> Any:
     """Materialize a bf16 param tree from a quantized one."""
     return jax.tree.map(

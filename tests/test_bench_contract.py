@@ -16,13 +16,14 @@ import bench
 
 @pytest.fixture
 def restore_bench(monkeypatch, tmp_path):
-    """Stub seams + redirect the dense sidecar into tmp."""
+    """Stub seams + redirect the sidecar artifacts into tmp."""
     real_open = open
     sidecar = tmp_path / "DENSE_BENCH.json"
 
     def fake_open(path, *a, **k):
-        if str(path).endswith("DENSE_BENCH.json"):
-            return real_open(sidecar, *a, **k)
+        for name in ("DENSE_BENCH.json", "REF_TABLE.json"):
+            if str(path).endswith(name):
+                return real_open(tmp_path / name, *a, **k)
         return real_open(path, *a, **k)
 
     monkeypatch.setattr(bench, "open", fake_open, raising=False)
@@ -82,7 +83,10 @@ def test_tpu_flow_headline_and_flagship_embed(monkeypatch, restore_bench):
 
     monkeypatch.setattr(bench, "_run_child", fake)
     out = _run_main()
-    assert calls == ["ref_debug_moe", "flagship_tuned", "dense200"]
+    assert calls == [
+        "ref_debug_moe", "flagship_tuned", "dense200",
+        *bench.REF_TABLE_RUNGS,
+    ]
     assert out["value"] == 1_474_875.0
     assert out["extras"]["flagship"]["value"] == 31_557.0
     assert out["extras"]["flagship"]["mfu"] == 0.229

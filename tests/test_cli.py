@@ -289,3 +289,87 @@ def test_finetune_adapter_and_chat(tmp_path, capsys):
         "--prompt", "hi", "--max-new-tokens", "4",
     ]) == 0
     assert capsys.readouterr().out
+
+
+def test_convert_int8_export_and_serve(tmp_path, capsys):
+    """cli convert --to int8 writes a quantized serving checkpoint (ref
+    trainer.py:681,712 GPTQ/quanto model saves): chat loads it directly
+    (QuantizedTensor leaves rebuilt from the manifest, no re-quantization)
+    and logits stay close to the source checkpoint's."""
+    out_dir = tmp_path / "run"
+    assert run_cli([
+        "train", "--preset", "debug", "--synthetic", "--steps", "2",
+        "--output-dir", str(out_dir), "--no-adaptive", "--no-oom-protect",
+        "--quiet", "--batch-size", "8",
+    ]) == 0
+    capsys.readouterr()
+    ckpt = str(out_dir / "checkpoints")
+    q_dir = tmp_path / "int8"
+    assert run_cli([
+        "convert", "--checkpoint", ckpt, "--to", "int8", "--out",
+        str(q_dir),
+    ]) == 0
+    assert "int8 serving export" in capsys.readouterr().out
+
+    import jax
+    import jax.numpy as jnp
+
+    from luminaai_tpu.inference.chat import load_model_for_inference
+    from luminaai_tpu.training.quantization import QuantizedTensor
+
+    m1, p1, _ = load_model_for_inference(ckpt)
+    m2, p2, c2 = load_model_for_inference(str(q_dir), allow_quantized=True)
+    qleaves = [
+        l for l in jax.tree_util.tree_leaves(
+            p2, is_leaf=lambda x: isinstance(x, QuantizedTensor)
+        )
+        if isinstance(l, QuantizedTensor)
+    ]
+    assert qleaves, "no quantized tensors reconstructed"
+    assert c2.quantization_method is None  # no double-quantize on load
+    ids = jnp.ones((1, 16), jnp.int32)
+    l1, _ = m1.apply({"params": p1}, ids, deterministic=True)
+    l2, _ = m2.apply({"params": p2}, ids, deterministic=True)
+    agree = float(
+        (jnp.argmax(l1, -1) == jnp.argmax(l2, -1)).mean()
+    )
+    assert agree > 0.9, agree
+
+    # The export is materially smaller on disk than the source.
+    def tree_bytes(d):
+        import os
+        return sum(
+            os.path.getsize(os.path.join(r, f))
+            for r, _, fs in os.walk(d) for f in fs
+        )
+    assert tree_bytes(q_dir) < 0.75 * tree_bytes(out_dir / "checkpoints")
+
+
+def test_int8_export_rejected_by_nonserving_consumers(tmp_path, capsys):
+    """An int8 serving export must be refused (clearly, not corrupted)
+    by convert/eval/finetune — only chat/serve may load it."""
+    out_dir = tmp_path / "run"
+    assert run_cli([
+        "train", "--preset", "debug", "--synthetic", "--steps", "2",
+        "--output-dir", str(out_dir), "--no-adaptive", "--no-oom-protect",
+        "--quiet", "--batch-size", "8",
+    ]) == 0
+    q_dir = tmp_path / "int8"
+    assert run_cli([
+        "convert", "--checkpoint", str(out_dir / "checkpoints"),
+        "--to", "int8", "--out", str(q_dir),
+    ]) == 0
+    capsys.readouterr()
+    # Double-quantization refused.
+    assert run_cli([
+        "convert", "--checkpoint", str(q_dir), "--to", "int8",
+        "--out", str(tmp_path / "again"),
+    ]) == 1
+    assert "SERVING checkpoint" in capsys.readouterr().err
+    # Full-precision consumers refuse too.
+    import pytest as _pytest
+
+    from luminaai_tpu.inference.chat import load_model_for_inference
+
+    with _pytest.raises(ValueError, match="SERVING checkpoint"):
+        load_model_for_inference(str(q_dir), keep_master_dtype=True)
