@@ -8,25 +8,40 @@
 # artifact with the new ref-matched headline rung, then the op/serving
 # benches.
 cd /root/repo
+# Hard deadline: the DRIVER captures the round artifact (BENCH_r04) at
+# round end and needs the single chip free — this watcher must never be
+# mid-queue then. Default 6h from launch; override WATCHER_DEADLINE_EPOCH.
+DEADLINE=${WATCHER_DEADLINE_EPOCH:-$(( $(date +%s) + 6*3600 ))}
 PROBE='import jax, jax.numpy as jnp
 x = jnp.ones((1024, 1024), jnp.bfloat16)
 float((x @ x).sum())
 print("PROBE_OK", jax.devices()[0].platform)'
+stage() {  # stage <budget_s> <cmd...>: run unless past deadline
+  local budget=$1; shift
+  local now=$(date +%s)
+  if (( now + budget > DEADLINE )); then
+    echo "$(date -u +%FT%TZ) SKIP (deadline): $*" >> scripts/sweep_out3.txt
+    return 1
+  fi
+  timeout -k 30 "$budget" "$@" >> scripts/sweep_out3.txt 2>&1
+  echo "$(date -u +%FT%TZ) rc=$? after: $*" >> scripts/sweep_out3.txt
+}
 while true; do
+  if (( $(date +%s) > DEADLINE )); then
+    echo "$(date -u +%FT%TZ) watcher deadline reached; exiting" >> scripts/watcher_log.txt
+    exit 0
+  fi
   # -k 10: a tunnel-wedged probe can ignore TERM while holding the output
   # pipe open, deadlocking the whole loop — KILL it after a grace period.
   out=$(timeout -k 10 90 python -c "$PROBE" 2>/dev/null)
   if echo "$out" | grep -q "PROBE_OK tpu"; then
     echo "$(date -u +%FT%TZ) tunnel up" >> scripts/sweep_out3.txt
     echo "$(date -u +%FT%TZ) bench.py first (headline artifact before anything can wedge)" >> scripts/sweep_out3.txt
-    timeout -k 30 4200 python bench.py >> scripts/sweep_out3.txt 2>&1
-    echo "$(date -u +%FT%TZ) bench.py rc=$?" >> scripts/sweep_out3.txt
-    timeout -k 30 6000 python scripts/perf_sweep.py attn best_r4 gmm rope16 b24_q8_attn_gather rope16_gmm b24_q8_gmm_attn b32_q8_attn_gather attn_blk512 long8k long8k_win1k >> scripts/sweep_out3.txt 2>&1
-    echo "$(date -u +%FT%TZ) sweep rc=$?" >> scripts/sweep_out3.txt
-    timeout -k 30 2400 python bench_ops.py >> scripts/sweep_out3.txt 2>&1
-    echo "$(date -u +%FT%TZ) bench_ops rc=$?" >> scripts/sweep_out3.txt
-    timeout -k 30 1800 python scripts/serve_bench.py 2 4 8 >> scripts/sweep_out3.txt 2>&1
-    echo "$(date -u +%FT%TZ) all done" >> scripts/sweep_out3.txt
+    stage 4200 python bench.py
+    stage 6000 python scripts/perf_sweep.py attn best_r4 gmm rope16 b24_q8_attn_gather rope16_gmm b24_q8_gmm_attn b32_q8_attn_gather attn_blk512 long8k long8k_win1k
+    stage 2400 python bench_ops.py
+    stage 1800 python scripts/serve_bench.py 2 4 8
+    echo "$(date -u +%FT%TZ) queue done" >> scripts/sweep_out3.txt
     exit 0
   fi
   echo "$(date -u +%FT%TZ) tunnel down" >> scripts/watcher_log.txt
