@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Flagship perf sweep on the real chip: time step variants with honest
 host-transfer sync. Usage: python perf_sweep.py [variant ...]"""
-import sys, time, gc
+import sys, time, gc, json, os
 sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__))))
 from bench_common import enable_compile_cache
 enable_compile_cache()  # before first jax compile
@@ -169,6 +169,25 @@ for name in names:
         print(f"{name:10s} step {dt*1e3:8.1f} ms  {tps:9.0f} tok/s "
               f"compile {compile_s:6.1f}s loss {float(m['loss']):.3f}",
               flush=True)
+        # Persist every measurement (VERDICT r4: sweep results died in
+        # scrollback .txt files while the round artifact fell back to CPU).
+        try:
+            rec = {
+                "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                "variant": name,
+                "platform": jax.devices()[0].platform,
+                "step_ms": round(dt * 1e3, 1),
+                "tokens_per_sec_per_chip": round(tps, 0),
+                "compile_s": round(compile_s, 1),
+                "loss": round(float(m["loss"]), 4),
+                "batch": cfg.batch_size,
+                "seq": cfg.seq_length,
+            }
+            with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   "sweep_results.jsonl"), "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        except OSError:
+            pass
         del state, step, m, batch
         gc.collect()
     except Exception as e:

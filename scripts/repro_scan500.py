@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Root-cause ladder for the on-chip `remote_compile HTTP 500` failures.
+
+r3's sweep showed three flagship variants die in the tunnel's compile
+helper (`INTERNAL: http://127.0.0.1:.../remote_compile: HTTP 500:
+tpu_compile_helper subprocess exit code 1`): `scan` (scan_layers=True),
+`dots` (remat_policy=dots_saveable), and `b24_attn_gather`. The failure is
+inside the remote compile service, so the usual suspects are program size /
+compile memory / compile time — not a numerics bug in our code. This script
+runs a ladder of progressively closer approximations in subprocesses
+(hang-proof) and reports the first rung that dies, which localizes the
+trigger:
+
+  1..3  generic lax.scan programs (tiny -> stacked params + remat)
+  4..6  the real model with scan_layers at increasing depth/width
+  7     dots_saveable on a small model (separates policy from scan)
+  8     flagship scan_layers (the failing sweep variant, for the record)
+
+Run on a live chip: `python scripts/repro_scan500.py [stage ...]`.
+Output appends to scripts/repro_scan500_out.txt.
+"""
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+OUT = os.path.join(HERE, "repro_scan500_out.txt")
+
+PRELUDE = """
+import sys
+sys.path.insert(0, __ROOT__)
+from bench_common import enable_compile_cache
+enable_compile_cache()
+import jax, jax.numpy as jnp
+import numpy as np
+"""
+
+MODEL_BODY = """
+import dataclasses
+from bench import _child_config
+from luminaai_tpu.models.transformer import LuminaTransformer
+from luminaai_tpu.parallel.mesh import build_mesh
+from luminaai_tpu.parallel.sharding import init_sharded_state
+from luminaai_tpu.parallel.train_step import make_train_step
+from luminaai_tpu.training.optimizer import make_optimizer, make_schedule
+cfg = dataclasses.replace(_child_config("flagship", 1), **OVERRIDES)
+model = LuminaTransformer(cfg)
+schedule = make_schedule(cfg, 100)
+tx = make_optimizer(cfg, 100, schedule)
+mesh = build_mesh(cfg)
+state, shardings = init_sharded_state(cfg, model, tx, mesh, jax.random.key(0))
+step = make_train_step(cfg, model, shardings, mesh, schedule, tx)
+ids = np.random.RandomState(0).randint(1, cfg.vocab_size,
+                                       size=(cfg.batch_size, cfg.seq_length))
+state, m = step(state, {"input_ids": jnp.asarray(ids, jnp.int32)})
+print("OK loss", float(m["loss"]))
+"""
+
+STAGES = {
+    # Generic scans, no model code: is lax.scan itself the trigger?
+    "scan_tiny": PRELUDE + """
+def body(c, _):
+    return c @ c * 0.5, ()
+x = jnp.ones((256, 256), jnp.bfloat16)
+y, _ = jax.jit(lambda x: jax.lax.scan(body, x, None, length=10))(x)
+print("OK", float(y.sum()))
+""",
+    "scan_stacked_remat": PRELUDE + """
+# Stacked per-layer params + remat inside the scan body: the structural
+# shape of scan_layers without any of our model code.
+H = 1024
+ws = jnp.ones((10, H, H), jnp.bfloat16) * 0.01
+def layer(x, w):
+    return jnp.tanh(x @ w), ()
+layer = jax.checkpoint(layer)
+def fwd(x, ws):
+    y, _ = jax.lax.scan(layer, x, ws)
+    return y.sum()
+g = jax.jit(jax.grad(fwd))(jnp.ones((8, H), jnp.bfloat16), ws)
+print("OK", float(g.sum()))
+""",
+    "scan_stacked_big": PRELUDE + """
+# Same, flagship-ish widths (1024 hidden, seq dim folded into batch).
+H = 1024
+ws = jnp.full((10, H, 4 * H), 0.01, jnp.bfloat16)
+vs = jnp.full((10, 4 * H, H), 0.01, jnp.bfloat16)
+def layer(x, wv):
+    w, v = wv
+    return x + jnp.maximum(x @ w, 0) @ v, ()
+layer = jax.checkpoint(layer)
+def fwd(x, ws, vs):
+    y, _ = jax.lax.scan(layer, x, (ws, vs))
+    return y.astype(jnp.float32).sum()
+g = jax.jit(jax.grad(fwd))(jnp.ones((16 * 128, H), jnp.bfloat16), ws, vs)
+print("OK", float(g.sum()))
+""",
+    # The real model under scan_layers, growing toward the flagship.
+    "model_scan_small": PRELUDE + "OVERRIDES = dict(scan_layers=True, "
+    "num_layers=2, hidden_size=256, batch_size=2, seq_length=256, "
+    "micro_batch_size=None)" + MODEL_BODY,
+    "model_scan_mid": PRELUDE + "OVERRIDES = dict(scan_layers=True, "
+    "num_layers=10, hidden_size=512, batch_size=4, seq_length=1024, "
+    "micro_batch_size=None)" + MODEL_BODY,
+    "model_scan_fullwidth_b4": PRELUDE + "OVERRIDES = dict(scan_layers=True, "
+    "batch_size=4, micro_batch_size=None)" + MODEL_BODY,
+    # Separates the remat policy from scan: dots_saveable, small model.
+    "model_dots_small": PRELUDE + "OVERRIDES = dict("
+    "remat_policy='dots_saveable', num_layers=2, hidden_size=256, "
+    "batch_size=2, seq_length=256, micro_batch_size=None)" + MODEL_BODY,
+    # The actual failing sweep variant, for the record.
+    "model_scan_flagship": PRELUDE + "OVERRIDES = dict(scan_layers=True)"
+    + MODEL_BODY,
+}
+
+
+def log(msg: str) -> None:
+    line = f"{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())} {msg}"
+    print(line, flush=True)
+    with open(OUT, "a") as f:
+        f.write(line + "\n")
+
+
+def main() -> None:
+    names = sys.argv[1:] or list(STAGES)
+    for name in names:
+        code = STAGES[name].replace("__ROOT__", repr(ROOT))
+        t0 = time.time()
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", code], capture_output=True,
+                text=True, timeout=900, cwd=ROOT,
+            )
+        except subprocess.TimeoutExpired:
+            log(f"{name:24s} HANG (>900s)")
+            continue
+        dt = time.time() - t0
+        if proc.returncode == 0 and "OK" in proc.stdout:
+            log(f"{name:24s} PASS ({dt:.0f}s) {proc.stdout.strip()[:80]}")
+        else:
+            tail = [
+                ln for ln in (proc.stderr or "").splitlines()
+                if "Error" in ln or "error" in ln or "INTERNAL" in ln
+            ]
+            log(
+                f"{name:24s} FAIL ({dt:.0f}s rc={proc.returncode}) "
+                + " | ".join(t[:160] for t in tail[-3:])
+            )
+
+
+if __name__ == "__main__":
+    main()

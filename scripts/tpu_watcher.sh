@@ -3,12 +3,13 @@
 # on-chip work and leave results in scripts/sweep_out3.txt. Single-shot:
 # exits after the queue drains.
 #
-# r3 queue (tunnel died mid-session after the save_attn lever was timed at
-# 31.6k tok/s): finish the batch/q8 composition sweep, capture the bench.py
-# artifact with the new ref-matched headline rung, then the op/serving
+# r5 queue: bench.py first (it now PERSISTS the headline to
+# scripts/last_good_bench.json, so one success fixes the artifact story
+# for good), then the HTTP-500 root-cause ladder, then the batched A/B
+# sweep (best_r4 + gmm + rope16 + long-context rungs), then op/serving
 # benches.
 cd /root/repo
-# Hard deadline: the DRIVER captures the round artifact (BENCH_r04) at
+# Hard deadline: the DRIVER captures the round artifact (BENCH_r05) at
 # round end and needs the single chip free — this watcher must never be
 # mid-queue then. Default 6h from launch; override WATCHER_DEADLINE_EPOCH.
 DEADLINE=${WATCHER_DEADLINE_EPOCH:-$(( $(date +%s) + 6*3600 ))}
@@ -38,6 +39,7 @@ while true; do
     echo "$(date -u +%FT%TZ) tunnel up" >> scripts/sweep_out3.txt
     echo "$(date -u +%FT%TZ) bench.py first (headline artifact before anything can wedge)" >> scripts/sweep_out3.txt
     stage 4200 python bench.py
+    stage 3600 python scripts/repro_scan500.py
     stage 6000 python scripts/perf_sweep.py attn best_r4 gmm rope16 b24_q8_attn_gather rope16_gmm b24_q8_gmm_attn b32_q8_attn_gather attn_blk512 long8k long8k_win1k
     stage 2400 python bench_ops.py
     stage 1800 python scripts/serve_bench.py 2 4 8
