@@ -341,32 +341,40 @@ class PackedDataset:
         length distribution — short/easy docs first, the long tail as the
         model earns it. Deterministic from shared metadata, so multi-host
         shards stay disjoint and in lockstep. Applies to the NEXT epoch's
-        iteration (a running iterator keeps its order)."""
+        iteration (a running iterator keeps its order: __iter__ snapshots
+        the value once, so the lockstep cap and every wrap re-walk use the
+        same filter even if this is called mid-epoch)."""
         self.difficulty = float(np.clip(difficulty, 0.0, 1.0))
 
-    def _global_order(self) -> np.ndarray:
+    # Sentinel: helpers read self.difficulty unless an iterator passes its
+    # epoch snapshot explicitly.
+    _LIVE = object()
+
+    def _global_order(self, difficulty=_LIVE) -> np.ndarray:
         """The one doc order every host derives identically (shared seed),
         so the per-host strides below are disjoint + exhaustive."""
+        if difficulty is PackedDataset._LIVE:
+            difficulty = self.difficulty
         n = self.cache.n_docs
         if self.shuffle_seed is not None:
             order = np.asarray(shuffle_indices(n, self.shuffle_seed))
         else:
             order = np.arange(n)
-        if self.difficulty is not None and self.difficulty < 1.0:
+        if difficulty is not None and difficulty < 1.0:
             doclens = np.diff(self.cache.offsets)
-            cutoff = np.quantile(doclens, max(self.difficulty, 0.05))
+            cutoff = np.quantile(doclens, max(difficulty, 0.05))
             keep = doclens[order] <= cutoff
             if keep.any():  # never filter down to an empty epoch
                 order = order[keep]
         return order
 
-    def _doc_order(self, host: int, wrap: int = 0) -> np.ndarray:
+    def _doc_order(self, host: int, wrap: int = 0, difficulty=_LIVE) -> np.ndarray:
         """Doc ids host `host` walks this epoch (its stride of the global
         order). `wrap` permutes the host's OWN shard for a re-walk after
         an early pack-out — never a different global order, so a wrapped
         host still reads only its shard, and the re-walk isn't a
         byte-identical replay."""
-        shard = self._global_order()[host::self.process_count]
+        shard = self._global_order(difficulty)[host::self.process_count]
         if wrap and len(shard) > 1:
             perm = np.asarray(shuffle_indices(
                 len(shard), (self.shuffle_seed or 0) + 7919 * wrap
@@ -374,13 +382,13 @@ class PackedDataset:
             shard = shard[perm]
         return shard
 
-    def _lockstep_batches(self) -> int:
+    def _lockstep_batches(self, difficulty=_LIVE) -> int:
         """Per-epoch batch count every host agrees on, from metadata only:
         min over hosts of (shard tokens // local batch tokens). Computed
         identically everywhere (shared offsets table + shared seed), so
         no communication is needed to stay in lockstep."""
         doclens = np.diff(self.cache.offsets)
-        order = self._global_order()
+        order = self._global_order(difficulty)
         per_batch = self.local_batch * self.seq_length
         return min(
             int(doclens[order[q::self.process_count]].sum()) // per_batch
@@ -388,7 +396,13 @@ class PackedDataset:
         )
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
-        filtered = self.difficulty is not None and self.difficulty < 1.0
+        # Snapshot once: a mid-epoch set_difficulty otherwise changes the
+        # wrap re-walk order after the lockstep cap was computed from the
+        # old order — a host whose newly-filtered shard packs zero batches
+        # on a wrap would return below the agreed cap and hang the
+        # collective on the other hosts.
+        difficulty = self.difficulty
+        filtered = difficulty is not None and difficulty < 1.0
         if self.process_count == 1 and self.shuffle_seed is None and not filtered:
             # Fast path: sequential cursor straight over the memmap, no
             # per-doc copies.
@@ -412,17 +426,19 @@ class PackedDataset:
                 }
             return
         if self.process_count == 1:
-            yield from self._iter_docs(self._doc_order(0), self.batch_size)
+            yield from self._iter_docs(
+                self._doc_order(0, difficulty=difficulty), self.batch_size
+            )
             return
         # Multi-host: fixed agreed batch count; wrap own shard if it packs
         # short (possible in truncate mode, where row-boundary waste makes
         # the metadata estimate an upper bound).
-        cap = self._lockstep_batches()
+        cap = self._lockstep_batches(difficulty)
         count = 0
         wrap = 0
         while count < cap:
             produced = False
-            order = self._doc_order(self.process_index, wrap)
+            order = self._doc_order(self.process_index, wrap, difficulty=difficulty)
             for b in self._iter_docs(order, self.local_batch):
                 produced = True
                 yield b

@@ -33,9 +33,11 @@ class QuantizedTensor(struct.PyTreeNode):
 
     q holds int8 codes ([-127,127] for 8-bit; two int4 nibbles per byte
     for 4-bit, packed along the quantization axis). scale is fp32, shaped
-    like the original with the quantized axis/axes reduced to 1. Lives in
-    ops/ (next to its kernels) so models/ can consume it without
-    depending on the training package.
+    like the original with the quantized axis/axes reduced to 1. axis is
+    ALWAYS a normalized (non-negative) tuple, even for a single axis —
+    quantize_array canonicalizes, so consumers never branch on int-vs-
+    tuple. Lives in ops/ (next to its kernels) so models/ can consume it
+    without depending on the training package.
     """
 
     q: jax.Array
@@ -46,16 +48,17 @@ class QuantizedTensor(struct.PyTreeNode):
 
     def dequantize(self, dtype=jnp.bfloat16) -> jax.Array:
         if self.bits == 4:
+            ax = self.axis[0]  # int4 is always single-axis
             packed = self.q.astype(jnp.int8)
             low = jnp.left_shift(packed, 4) >> 4  # sign-extended low nibble
             high = packed >> 4
-            vals = jnp.stack([low, high], axis=self.axis + 1)
+            vals = jnp.stack([low, high], axis=ax + 1)
             new_shape = list(self.q.shape)
-            new_shape[self.axis] *= 2
+            new_shape[ax] *= 2
             vals = vals.reshape(new_shape)
             # Un-pad to the original length along the packed axis.
             idx = [slice(None)] * vals.ndim
-            idx[self.axis] = slice(0, self.orig_shape[self.axis])
+            idx[ax] = slice(0, self.orig_shape[ax])
             vals = vals[tuple(idx)]
         else:
             vals = self.q
@@ -67,33 +70,33 @@ def quantize_array(
 ) -> QuantizedTensor:
     """Symmetric per-channel quantization, scales reduced over `axis`.
 
-    `axis` may be a tuple (int8 only) — the serving path quantizes over
-    the matmul CONTRACTION axes so the scale factors out of the int8 dot
-    (the layout contracts above)."""
+    `axis` may be an int or a tuple (multi-axis is int8-only — the
+    serving path quantizes over the matmul CONTRACTION axes so the scale
+    factors out of the int8 dot; see the layout contracts above). The
+    stored QuantizedTensor.axis is always a normalized tuple."""
     if isinstance(axis, tuple):
-        if bits == 4:
+        if bits == 4 and len(axis) != 1:
             raise ValueError("multi-axis quantization is int8-only")
         axis = tuple(a % w.ndim for a in axis)
-        if len(axis) == 1:
-            axis = axis[0]
     else:
-        axis = axis % w.ndim
+        axis = (axis % w.ndim,)
     w32 = w.astype(jnp.float32)
     qmax = 127.0 if bits == 8 else 7.0
     amax = jnp.max(jnp.abs(w32), axis=axis, keepdims=True)
     scale = jnp.where(amax > 0, amax / qmax, 1.0)
     q = jnp.clip(jnp.round(w32 / scale), -qmax, qmax).astype(jnp.int8)
     if bits == 4:
-        n = q.shape[axis]
+        ax = axis[0]
+        n = q.shape[ax]
         if n % 2:  # pad to an even length for nibble packing
             pad = [(0, 0)] * q.ndim
-            pad[axis] = (0, 1)
+            pad[ax] = (0, 1)
             q = jnp.pad(q, pad)
         lohi = q.reshape(
-            *q.shape[:axis], q.shape[axis] // 2, 2, *q.shape[axis + 1:]
+            *q.shape[:ax], q.shape[ax] // 2, 2, *q.shape[ax + 1:]
         )
-        low = jax.lax.index_in_dim(lohi, 0, axis + 1, keepdims=False)
-        high = jax.lax.index_in_dim(lohi, 1, axis + 1, keepdims=False)
+        low = jax.lax.index_in_dim(lohi, 0, ax + 1, keepdims=False)
+        high = jax.lax.index_in_dim(lohi, 1, ax + 1, keepdims=False)
         q = (
             (high.astype(jnp.int32) << 4) | (low.astype(jnp.int32) & 0xF)
         ).astype(jnp.int8)
@@ -114,14 +117,18 @@ def quantize_act(x: jax.Array):
 
 
 def _check(qt: QuantizedTensor, contraction_axes) -> None:
-    assert qt.bits == 8, "int8 compute path needs 8-bit codes"
-    axes = qt.axis if isinstance(qt.axis, tuple) else (qt.axis,)
+    # ValueError, not assert: these run once at trace time (no runtime
+    # cost) and a layout mismatch under `python -O` would otherwise run
+    # the int8 dot with wrong scales and produce silently wrong logits.
+    if qt.bits != 8:
+        raise ValueError("int8 compute path needs 8-bit codes")
     want = tuple(a % qt.q.ndim for a in contraction_axes)
-    got = tuple(a % qt.q.ndim for a in axes)
-    assert got == want, (
-        f"weight quantized over axes {got}, int8 kernel contracts {want} — "
-        "re-quantize with quantize_for_serving"
-    )
+    got = tuple(a % qt.q.ndim for a in qt.axis)
+    if got != want:
+        raise ValueError(
+            f"weight quantized over axes {got}, int8 kernel contracts "
+            f"{want} — re-quantize with quantize_for_serving"
+        )
 
 
 def int8_project(x: jax.Array, qt: QuantizedTensor, out_dtype) -> jax.Array:

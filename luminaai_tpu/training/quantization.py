@@ -45,14 +45,31 @@ def quantize_tree(
     """Quantize eligible weight leaves of a param tree.
 
     Returns (tree with QuantizedTensor leaves, info dict with byte counts).
+    Idempotent: leaves that are already QuantizedTensor pass through
+    unchanged (re-quantizing their q/scale fields would nest QTs and fail
+    at trace time — ADVICE r4).
     """
-    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(
+        params, is_leaf=lambda x: isinstance(x, QuantizedTensor)
+    )
     out = []
     before = after = quantized = 0
     for path, leaf in flat:
         keys = tuple(
             p.key for p in path if isinstance(p, jax.tree_util.DictKey)
         )
+        if isinstance(leaf, QuantizedTensor):
+            if leaf.bits == bits:
+                nbytes = leaf.q.nbytes + leaf.scale.nbytes
+                before += nbytes
+                after += nbytes
+                quantized += 1
+                out.append(leaf)
+                continue
+            # Different bit-width requested (e.g. int4 over an int8
+            # export): round-trip through full precision so the result
+            # really is `bits`-wide, not a mislabeled passthrough.
+            leaf = leaf.dequantize(jnp.bfloat16)
         before += leaf.nbytes
         if _eligible(keys, leaf, min_size):
             qt = quantize_array(leaf, bits=bits, axis=-1)
@@ -109,13 +126,40 @@ def quantize_for_serving(
     int8xint8→int32 MXU dots via ops/quantized.py — the TPU counterpart
     of the reference's kernel-swapping quantization (ref trainer.py:658).
     """
-    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(
+        params, is_leaf=lambda x: isinstance(x, QuantizedTensor)
+    )
     out = []
     before = after = quantized = 0
     for path, leaf in flat:
         keys = tuple(
             p.key for p in path if isinstance(p, jax.tree_util.DictKey)
         )
+        if isinstance(leaf, QuantizedTensor):
+            expect = _serving_axis(
+                keys,
+                jax.ShapeDtypeStruct(leaf.orig_shape, jnp.bfloat16),
+            )
+            if (
+                leaf.bits == 8
+                and expect is not None
+                and tuple(a % len(leaf.orig_shape) for a in expect)
+                == leaf.axis
+            ):
+                # Already in the serving layout (e.g. chat/serve
+                # --quantize int8 pointed at an int8 export): pass
+                # through — idempotent.
+                nbytes = leaf.q.nbytes + leaf.scale.nbytes
+                before += nbytes
+                after += nbytes
+                quantized += 1
+                out.append(leaf)
+                continue
+            # Wrong layout for int8 compute (storage-axis or int4 leaf):
+            # round-trip through full precision and re-quantize over the
+            # contraction axes instead of deferring to a confusing
+            # trace-time layout error.
+            leaf = leaf.dequantize(jnp.bfloat16)
         before += leaf.nbytes
         axes = (
             _serving_axis(keys, leaf)
@@ -163,10 +207,7 @@ def export_quantized_tree(qtree: Any) -> Tuple[Any, Dict[str, Any]]:
         if isinstance(leaf, QuantizedTensor):
             manifest[_path_str(path)] = {
                 "bits": leaf.bits,
-                "axis": (
-                    list(leaf.axis)
-                    if isinstance(leaf.axis, tuple) else leaf.axis
-                ),
+                "axis": list(leaf.axis),
                 "orig_shape": list(leaf.orig_shape),
             }
             out.append({"q": leaf.q, "scale": leaf.scale})
@@ -195,7 +236,8 @@ def import_quantized_tree(plain: Any, manifest: Dict[str, Any]) -> Any:
                 q=leaf["q"],
                 scale=leaf["scale"],
                 bits=int(m["bits"]),
-                axis=tuple(axis) if isinstance(axis, list) else int(axis),
+                # Older manifests stored a bare int for single-axis.
+                axis=tuple(axis) if isinstance(axis, list) else (int(axis),),
                 orig_shape=tuple(m["orig_shape"]),
             ))
         else:

@@ -437,6 +437,41 @@ def test_packed_dataset_length_curriculum(tmp_path, tok):
     assert c0 == c1 > 0
 
 
+def test_mid_epoch_set_difficulty_keeps_lockstep(tmp_path, tok):
+    """A running iterator snapshots difficulty at __iter__: tightening the
+    curriculum mid-epoch must not change the wrap re-walk order after the
+    lockstep cap was computed, or hosts desync and hang the collective
+    (ADVICE r4)."""
+    p = tmp_path / "mid.jsonl"
+    with open(p, "w") as f:
+        for i in range(30):
+            f.write(json.dumps({"text": "word " * (5 + i * 7)}) + "\n")
+    cache = build_text_cache(str(p), str(tmp_path / "midc"), tok)
+    hosts = [
+        PackedDataset(cache, batch_size=2, seq_length=32,
+                      pad_id=tok.pad_token_id,
+                      process_index=q, process_count=2)
+        for q in range(2)
+    ]
+    counts = []
+    for h in hosts:
+        cap = h._lockstep_batches()
+        it = iter(h)
+        n = 0
+        first = next(it, None)
+        if first is not None:
+            n += 1
+        # Tighten the curriculum while the epoch is running: the snapshot
+        # must keep this iterator on the OLD order/cap.
+        h.set_difficulty(0.2)
+        for _ in it:
+            n += 1
+        counts.append((cap, n))
+        h.difficulty = None  # reset for symmetry (hosts share lockstep)
+    (cap0, n0), (cap1, n1) = counts
+    assert cap0 == cap1 and n0 == n1 == cap0 > 0
+
+
 def test_conversation_batches_process_sharding(tmp_path, tok):
     """Host shards of conversation batches: local rows, lockstep counts,
     disjoint+exhaustive coverage of the global batch rows."""

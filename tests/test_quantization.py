@@ -235,6 +235,82 @@ def test_quantize_for_serving_axes_and_roles():
                 assert leaf.scale.shape == (1, 1, leaf.orig_shape[2])
 
 
+def test_quantize_for_serving_idempotent():
+    """Re-quantizing a tree that already holds QuantizedTensor leaves
+    (chat/serve --quantize int8 pointed at an int8 serving export) must
+    pass them through unchanged — not nest QT(q=QT(...)) and explode at
+    trace time in int8_project (ADVICE r4 medium)."""
+    from luminaai_tpu.training.quantization import quantize_for_serving
+
+    cfg = tiny_config(use_moe=True, num_experts=4, moe_top_k=2,
+                      routing_noise_std=0.0)
+    model = LuminaTransformer(cfg)
+    ids = jnp.asarray(
+        np.random.RandomState(0).randint(1, 256, (2, 32)), jnp.int32
+    )
+    params = model.init(jax.random.key(0), ids)["params"]
+    qp1, info1 = quantize_for_serving(params, min_size=1024)
+    qp2, info2 = quantize_for_serving(qp1, min_size=1024)
+    assert info2["quantized_leaves"] == info1["quantized_leaves"]
+    flat1 = jax.tree_util.tree_leaves(
+        qp1, is_leaf=lambda x: isinstance(x, QuantizedTensor)
+    )
+    flat2 = jax.tree_util.tree_leaves(
+        qp2, is_leaf=lambda x: isinstance(x, QuantizedTensor)
+    )
+    for a, b in zip(flat1, flat2):
+        if isinstance(a, QuantizedTensor):
+            assert b is a  # passed through, not re-quantized
+            assert not isinstance(a.q, QuantizedTensor)
+    # The re-quantized tree still traces and runs the int8 path.
+    qlogits, _ = model.apply({"params": qp2}, ids, deterministic=True)
+    assert bool(jnp.isfinite(qlogits).all())
+    # quantize_tree (storage path) is idempotent the same way.
+    qt1, i1 = quantize_tree(params, bits=8, min_size=1024)
+    qt2, i2 = quantize_tree(qt1, bits=8, min_size=1024)
+    assert i2["quantized_leaves"] == i1["quantized_leaves"]
+    # A DIFFERENT bit-width re-quantizes (round-trips through bf16)
+    # instead of passing mismatched leaves through under the new label.
+    qt4, i4 = quantize_tree(qt1, bits=4, min_size=1024)
+    four_bit = [
+        l for l in jax.tree_util.tree_leaves(
+            qt4, is_leaf=lambda x: isinstance(x, QuantizedTensor)
+        ) if isinstance(l, QuantizedTensor)
+    ]
+    assert four_bit and all(l.bits == 4 for l in four_bit)
+    # Storage-layout trees fed to quantize_for_serving get re-quantized
+    # into the serving (contraction-axis) layout, then trace fine.
+    qs, _ = quantize_for_serving(qt1, min_size=1024)
+    slogits, _ = model.apply({"params": qs}, ids, deterministic=True)
+    assert bool(jnp.isfinite(slogits).all())
+
+
+def test_quantized_axis_always_tuple():
+    """QuantizedTensor.axis is canonically a tuple for every entry path
+    (int axis, negative axis, tuple, int4), so consumers never branch on
+    int-vs-tuple (ADVICE r4)."""
+    w = jnp.asarray(np.random.RandomState(0).randn(16, 64), jnp.float32)
+    assert quantize_array(w, bits=8, axis=-1).axis == (1,)
+    assert quantize_array(w, bits=8, axis=0).axis == (0,)
+    assert quantize_array(w, bits=8, axis=(0, 1)).axis == (0, 1)
+    assert quantize_array(w, bits=4, axis=-1).axis == (1,)
+    # int4 dequantize still un-packs correctly through the tuple axis.
+    qt = quantize_array(w, bits=4, axis=0)
+    assert qt.dequantize(jnp.float32).shape == w.shape
+
+
+def test_int8_layout_mismatch_raises_valueerror():
+    """Layout contract violations raise ValueError (asserts are stripped
+    under python -O and would silently produce wrong logits)."""
+    from luminaai_tpu.ops.quantized import int8_project
+
+    w = jnp.asarray(np.random.RandomState(0).randn(64, 32), jnp.float32)
+    qt_wrong = quantize_array(w, bits=8, axis=-1)  # kernel wants axis 0
+    x = jnp.ones((2, 64), jnp.float32)
+    with pytest.raises(ValueError, match="quantized over axes"):
+        int8_project(x, qt_wrong, jnp.float32)
+
+
 @pytest.mark.parametrize("use_moe", [False, True])
 def test_int8_compute_model_forward_close(use_moe):
     """End-to-end quality delta: the model applied with QuantizedTensor
