@@ -384,27 +384,24 @@ class Config:
             )
             if self.moe_dispatch == "gmm":
                 # The megablox grouped-matmul kernel is a Pallas custom
-                # call GSPMD cannot partition, and the global expert-sort
-                # crosses the batch axis — under ANY multi-chip sharding
-                # XLA would all-gather/replicate the full token buffers,
-                # silently erasing the parallelism. Single-chip only
-                # (make_train_step enforces mesh.size == 1 for the
-                # inferred-dp case); use 'gather'/'sort' on meshes.
+                # call GSPMD cannot partition, so gmm runs under shard_map
+                # (models/moe.py _gmm_path): tokens shard over data/fsdp,
+                # experts over 'expert', partial outputs psum over
+                # 'expert'. tensor/sequence/pipe would split the hidden or
+                # sequence dimension INSIDE the kernel's rows — not
+                # expressible in that layout; use 'gather' there.
                 for name, size in (
-                    ("expert", self.expert_parallel_size),
                     ("pipeline", self.pipeline_parallel_size),
                     ("sequence", self.sequence_parallel_size),
                     ("tensor", self.tensor_parallel_size),
-                    ("fsdp", self.fsdp_parallel_size),
-                    # -1 (inferred) passes here; make_train_step/
-                    # make_eval_step catch the resolved multi-device mesh.
-                    ("data", max(self.data_parallel_size, 1)),
                 ):
                     assert size == 1, (
-                        f"moe_dispatch='gmm' is single-chip only "
-                        f"({name}_parallel_size={size}); use 'gather' or "
-                        "'sort' for sharded meshes"
+                        f"moe_dispatch='gmm' composes with data/fsdp/"
+                        f"expert mesh axes only ({name}_parallel_size="
+                        f"{size}); use 'gather' or 'sort' there"
                     )
+                # num_experts % expert_parallel_size is enforced by the
+                # unconditional expert-parallel check below.
             assert 0.0 <= self.expert_dropout_rate <= 0.5, (
                 "expert_dropout_rate must be in [0, 0.5]"
             )

@@ -471,97 +471,210 @@ class MoELayer(nn.Module):
     ) -> Tuple[jax.Array, jax.Array, jax.Array]:
         """Ragged expert FFN via the Pallas megablox grouped matmul.
 
-        Tokens are globally sorted by assigned expert; each expert's two
-        matmuls run over exactly its kept rows ([N_kept, H] x [H, 2F]),
-        so the capacity-padded [E, G, C, ·] buffers of the sort/gather
-        paths — and the ~cf·k/E-1 fraction of wasted padded-slot FLOPs —
-        never exist. Routing (slots, gates, drops, per-group capacity)
-        comes from the same _sort_routing, so outputs and stats match the
-        other dispatch modes exactly. (The TPU counterpart of the ref's
-        grouped CUDA expert kernels, Src/Main_Scripts/core/
-        moe_cuda_wrapper.py:628.)
+        Tokens are sorted by assigned expert; each expert's two matmuls
+        run over exactly its kept rows ([N_kept, H] x [H, 2F]), so the
+        capacity-padded [E, G, C, ·] buffers of the sort/gather paths —
+        and the ~cf·k/E-1 fraction of wasted padded-slot FLOPs — never
+        exist. Routing (slots, gates, drops, per-group capacity) comes
+        from the same _sort_routing, so outputs and stats match the other
+        dispatch modes exactly. (The TPU counterpart of the ref's grouped
+        CUDA expert kernels, Src/Main_Scripts/core/moe_cuda_wrapper.py:628.)
+
+        On a multi-device mesh the path runs under shard_map (GSPMD can't
+        partition the Pallas custom call): tokens stay sharded over
+        (data, fsdp) exactly as the activation rules place them, expert
+        weights stay sharded over 'expert', and each shard runs megablox
+        over only the pairs routed to ITS local experts — the kernel's
+        group_sizes bound keeps per-shard FLOPs proportional to locally
+        kept rows, so the zero-padding win survives dp/fsdp/ep
+        composition. A psum over 'expert' combines the partial token
+        outputs (each pair contributes on exactly the shard owning its
+        expert). tensor/sequence/pipe stay unsupported (config rejects).
 
         Returns (combined_out [G,S,H], tokens_per_expert [E], dropped [G,S]).
         """
         cfg = self.config
         G, S, H = x.shape
         E, k = cfg.num_experts, cfg.moe_top_k
-        C = capacity
-        N = G * S * k
-        assert N % 128 == 0, (
-            f"gmm dispatch needs groups*seq*top_k ({N}) to be a multiple "
-            "of the 128-row kernel tile; use 'gather' dispatch for this "
-            "shape"
+        gmm = _pick_gmm()
+
+        from luminaai_tpu.parallel.mesh import active_mesh
+
+        mesh = active_mesh()
+        multi = mesh is not None and mesh.size > 1
+        if not multi or self.is_initializing():
+            # Single device — or flax init, whose 1-row dummy batch can't
+            # satisfy the sharded layout and whose activations are dead
+            # code anyway (only param shapes survive init).
+            if not self.is_initializing():
+                _check_gmm_rows(G * S * k, 1)
+            return _gmm_local(
+                x, router_probs, wi, wo,
+                top_k=k, capacity=capacity, num_experts=E,
+                dtype=self.dtype, gmm_fn=gmm, ep_axis=None,
+            )
+
+        for ax in ("tensor", "sequence", "pipe"):
+            if mesh.shape.get(ax, 1) > 1:
+                raise ValueError(
+                    f"moe_dispatch='gmm' does not compose with the "
+                    f"'{ax}' mesh axis (size {mesh.shape[ax]}); use "
+                    "'gather' dispatch"
+                )
+        dp_total = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
+        if G % dp_total != 0:
+            raise ValueError(
+                f"gmm dispatch needs batch groups ({G}) divisible by "
+                f"data*fsdp ({dp_total})"
+            )
+        _check_gmm_rows(G * S * k, dp_total)
+
+        from jax.sharding import PartitionSpec as P
+
+        tok_spec = P(("data", "fsdp"), None, None)
+
+        def body(x_l, probs_l, wi_l, wo_l):
+            out, tpe, dropped = _gmm_local(
+                x_l, probs_l, wi_l, wo_l,
+                top_k=k, capacity=capacity, num_experts=E,
+                dtype=self.dtype, gmm_fn=gmm, ep_axis="expert",
+            )
+            # Each pair's FFN output lives on the shard owning its expert;
+            # tokens are replicated over 'expert', so a psum assembles the
+            # full combine. tokens_per_expert sums the per-token-shard
+            # local counts into the global [E] the aux-loss math expects.
+            out = jax.lax.psum(out, "expert")
+            tpe = jax.lax.psum(tpe, ("data", "fsdp"))
+            return out, tpe, dropped
+
+        sharded = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(tok_spec, tok_spec, P("expert", None, None),
+                      P("expert", None, None)),
+            out_specs=(tok_spec, P(), P(("data", "fsdp"), None)),
+            check_vma=False,
         )
-        on_tpu = jax.default_backend() == "tpu"
-        if _GMM_OVERRIDE is not None:
-            gmm = _GMM_OVERRIDE
-        elif on_tpu:
-            from jax.experimental.pallas.ops.tpu.megablox import gmm
-        else:
-            # Megablox's interpret mode is minutes-per-call even at test
-            # sizes; off-TPU a masked-matmul reference keeps the whole
-            # routing/sort/combine logic under CPU test with identical
-            # math (one dense [N,·]x[·,·] matmul per expert).
-            def gmm(lhs, rhs, group_sizes, preferred_element_type, **_):
-                bounds = jnp.cumsum(group_sizes)
-                row_expert = jnp.searchsorted(
-                    bounds, jnp.arange(lhs.shape[0]), side="right"
-                )
-                out = jnp.zeros(
-                    (lhs.shape[0], rhs.shape[-1]), preferred_element_type
-                )
-                for e in range(rhs.shape[0]):
-                    sel = (row_expert == e)[:, None].astype(lhs.dtype)
-                    out = out + (
-                        (lhs * sel) @ rhs[e]
-                    ).astype(preferred_element_type)
-                return out
+        return sharded(x, router_probs, wi, wo)
 
-        slot, gate, dropped, counts = _sort_routing(router_probs, k, C)
-        gate = gate.astype(self.dtype)
 
-        # Global pair -> expert; dropped pairs get sentinel E and sort
-        # after every real expert's run (excluded via group_sizes).
-        e_pair = jnp.where(slot < E * C, slot // C, E).reshape(-1)  # [N]
-        perm = jnp.argsort(e_pair, stable=True)  # [N] pair ids, expert-major
-        # Pair id p = ((g*S)+s)*k + r -> its token row in x_flat is p // k.
-        x_flat = x.astype(self.dtype).reshape(G * S, H)
-        group_sizes = counts.sum(axis=0).astype(jnp.int32)  # [E] kept rows
-        # Rows past sum(group_sizes) are never touched by the kernel: its
-        # forward leaves those output tiles uninitialized, and its custom
-        # VJP leaves the matching grad_lhs rows uninitialized too (it only
-        # zeroes the tail when rhs carries more groups than group_sizes —
-        # not the case here). Dropped pairs still map via perm//k to REAL
-        # token rows, so uninitialized grad rows would scatter-add garbage
-        # into real tokens' d_x through the x_flat[perm//k] gather VJP.
-        # jnp.where on the OPERANDS fixes both directions: its VJP selects
-        # (rather than multiplies), so cotangents for masked rows are
-        # annihilated exactly, and NaN garbage cannot leak through.
-        total_kept = group_sizes.sum()
-        row_kept = jnp.arange(N)[:, None] < total_kept  # [N, 1]
-        lhs = jnp.where(row_kept, x_flat[perm // k], 0)  # [N, H] sorted rows
+def _check_gmm_rows(n_rows: int, dp_total: int) -> None:
+    local = n_rows // max(dp_total, 1)
+    if local % 128 != 0:
+        raise ValueError(
+            f"gmm dispatch needs per-shard groups*seq*top_k rows ({local}) "
+            "to be a multiple of the 128-row kernel tile; use 'gather' "
+            "dispatch for this shape"
+        )
 
-        fused = gmm(
-            lhs,
-            wi.astype(self.dtype),
-            group_sizes,
-            preferred_element_type=self.dtype,
-        )  # [N, 2F]
-        gate_act, up = jnp.split(fused, 2, axis=-1)
-        act = jnp.where(row_kept, nn.silu(gate_act) * up, 0)
-        yrow = gmm(
-            act,
-            wo.astype(self.dtype),
-            group_sizes,
-            preferred_element_type=self.dtype,
-        )  # [N, H]
-        # Forward output tiles past the kept region are uninitialized too —
-        # zero them before the unsort so garbage can't meet a
-        # NaN-propagating gate product.
-        yrow = jnp.where(row_kept, yrow, 0.0)
 
-        inv_perm = jnp.argsort(perm)  # back to pair order
-        y_pairs = yrow[inv_perm].reshape(G, S, k, H)
-        out = jnp.einsum("gskh,gsk->gsh", y_pairs, gate)
-        return out, group_sizes.astype(jnp.float32), dropped
+def _pick_gmm():
+    """The grouped-matmul implementation for this backend: the Pallas
+    megablox kernel on TPU, a masked-matmul reference elsewhere (megablox
+    interpret mode is minutes-per-call even at test sizes; the fallback
+    keeps all routing/sort/combine logic under CPU test with identical
+    math), or the test-hook override."""
+    if _GMM_OVERRIDE is not None:
+        return _GMM_OVERRIDE
+    if jax.default_backend() == "tpu":
+        from jax.experimental.pallas.ops.tpu.megablox import gmm
+
+        return gmm
+
+    def gmm(lhs, rhs, group_sizes, preferred_element_type, **_):
+        bounds = jnp.cumsum(group_sizes)
+        row_expert = jnp.searchsorted(
+            bounds, jnp.arange(lhs.shape[0]), side="right"
+        )
+        out = jnp.zeros(
+            (lhs.shape[0], rhs.shape[-1]), preferred_element_type
+        )
+        for e in range(rhs.shape[0]):
+            sel = (row_expert == e)[:, None].astype(lhs.dtype)
+            out = out + (
+                (lhs * sel) @ rhs[e]
+            ).astype(preferred_element_type)
+        return out
+
+    return gmm
+
+
+def _gmm_local(
+    x: jax.Array, router_probs: jax.Array, wi, wo, *,
+    top_k: int, capacity: int, num_experts: int, dtype, gmm_fn,
+    ep_axis: Optional[str],
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One shard's ragged grouped-matmul expert FFN.
+
+    x [G, S, H] and router_probs [G, S, E] are this shard's LOCAL token
+    groups (the whole batch when unsharded); wi [E_l, H, 2F] / wo
+    [E_l, F, H] are its LOCAL experts. Routing runs over the FULL expert
+    dimension (probs carry all E columns) so capacity/drop semantics are
+    global; pairs routed to non-local experts sort to the excluded tail
+    exactly like dropped pairs, and their zeroed rows annihilate in the
+    combine — each pair contributes only on the shard owning its expert.
+
+    Returns (out [G,S,H] partial over experts, tokens_per_expert [E]
+    local-groups count, dropped [G,S])."""
+    G, S, H = x.shape
+    E, k, C = num_experts, top_k, capacity
+    E_l = wi.shape[0]
+    N = G * S * k
+
+    slot, gate, dropped, counts = _sort_routing(router_probs, k, C)
+    gate = gate.astype(dtype)
+
+    # Pair -> expert; dropped pairs get sentinel E_l and sort after every
+    # real (local) expert's run (excluded via group_sizes).
+    e_pair = jnp.where(slot < E * C, slot // C, E).reshape(-1)  # [N]
+    counts_e = counts.sum(axis=0).astype(jnp.int32)  # [E] kept, local groups
+    if ep_axis is not None and E_l != E:
+        # Expert-parallel shard: keep only pairs whose expert lives here;
+        # everything else joins the excluded tail.
+        e_lo = jax.lax.axis_index(ep_axis) * E_l
+        loc = e_pair - e_lo
+        e_sort = jnp.where((loc >= 0) & (loc < E_l), loc, E_l)
+        group_sizes = jax.lax.dynamic_slice_in_dim(counts_e, e_lo, E_l)
+    else:
+        e_sort = e_pair
+        group_sizes = counts_e
+    perm = jnp.argsort(e_sort, stable=True)  # [N] pair ids, expert-major
+    # Pair id p = ((g*S)+s)*k + r -> its token row in x_flat is p // k.
+    x_flat = x.astype(dtype).reshape(G * S, H)
+    # Rows past sum(group_sizes) are never touched by the kernel: its
+    # forward leaves those output tiles uninitialized, and its custom
+    # VJP leaves the matching grad_lhs rows uninitialized too (it only
+    # zeroes the tail when rhs carries more groups than group_sizes —
+    # not the case here). Dropped pairs still map via perm//k to REAL
+    # token rows, so uninitialized grad rows would scatter-add garbage
+    # into real tokens' d_x through the x_flat[perm//k] gather VJP.
+    # jnp.where on the OPERANDS fixes both directions: its VJP selects
+    # (rather than multiplies), so cotangents for masked rows are
+    # annihilated exactly, and NaN garbage cannot leak through.
+    total_kept = group_sizes.sum()
+    row_kept = jnp.arange(N)[:, None] < total_kept  # [N, 1]
+    lhs = jnp.where(row_kept, x_flat[perm // k], 0)  # [N, H] sorted rows
+
+    fused = gmm_fn(
+        lhs,
+        wi.astype(dtype),
+        group_sizes,
+        preferred_element_type=dtype,
+    )  # [N, 2F]
+    gate_act, up = jnp.split(fused, 2, axis=-1)
+    act = jnp.where(row_kept, nn.silu(gate_act) * up, 0)
+    yrow = gmm_fn(
+        act,
+        wo.astype(dtype),
+        group_sizes,
+        preferred_element_type=dtype,
+    )  # [N, H]
+    # Forward output tiles past the kept region are uninitialized too —
+    # zero them before the unsort so garbage can't meet a
+    # NaN-propagating gate product.
+    yrow = jnp.where(row_kept, yrow, 0.0)
+
+    inv_perm = jnp.argsort(perm)  # back to pair order
+    y_pairs = yrow[inv_perm].reshape(G, S, k, H)
+    out = jnp.einsum("gskh,gsk->gsh", y_pairs, gate)
+    return out, counts_e.astype(jnp.float32), dropped

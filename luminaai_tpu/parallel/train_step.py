@@ -193,20 +193,6 @@ def _accumulate_grads(
     return grads, metrics
 
 
-def _reject_gmm_on_mesh(config: Config, mesh: Mesh) -> None:
-    """gmm dispatch feeds an unpartitionable Pallas call and a global
-    expert-sort: on any multi-device mesh GSPMD would replicate the full
-    token buffers. Config validation catches explicit axis sizes; this
-    catches the inferred data axis (data_parallel_size=-1 resolving >1)
-    for both the train and eval step builders."""
-    if config.use_moe and config.moe_dispatch == "gmm" and mesh.size > 1:
-        raise ValueError(
-            "moe_dispatch='gmm' is single-chip only (the megablox Pallas "
-            f"call cannot be partitioned; mesh has {mesh.size} devices) — "
-            "use 'gather' or 'sort' dispatch on multi-chip meshes"
-        )
-
-
 def make_train_step(
     config: Config,
     model,
@@ -234,7 +220,6 @@ def make_train_step(
         return make_pipeline_train_step(
             config, model, state_shardings, mesh, schedule, tx
         )
-    _reject_gmm_on_mesh(config, mesh)
     loss_fn = loss_fn or make_loss_fn(config, model)
     accum = config.gradient_accumulation_steps
     bspec = NamedSharding(mesh, batch_spec())
@@ -290,7 +275,6 @@ def make_eval_step(
         from luminaai_tpu.parallel.pipeline import make_pipeline_eval_step
 
         return make_pipeline_eval_step(config, model, state_shardings, mesh)
-    _reject_gmm_on_mesh(config, mesh)
 
     def eval_loss(params, batch: Batch):
         model_out, aux = model.apply(
