@@ -158,7 +158,12 @@ class GenerationEngine:
         return prefill
 
     # -- decode loop -------------------------------------------------------
-    def _make_decode(self, gen_key):
+    def _make_decode(self, gen_key, carry: bool = False):
+        """The jitted decode while-loop. With carry=True the function also
+        returns (rng, token, caches, counts) so a caller can resume — the
+        chunked streaming path re-enters this loop every `chunk` tokens,
+        and because the body splits the rng exactly once per iteration,
+        the chunked token sequence is bit-identical to one long loop."""
         max_new, temperature, top_k, top_p, rep_penalty = gen_key
         max_new = max_new - 1  # the prefill already sampled token #1
         stop_ids = jnp.asarray(sorted(self._stop_set), dtype=jnp.int32)
@@ -198,6 +203,11 @@ class GenerationEngine:
             state = jax.lax.while_loop(
                 cond, functools.partial(body, params), state
             )
+            if carry:
+                return (
+                    state[6], state[0], state[5],
+                    state[1], state[2], state[3], state[4],
+                )
             return state[6], state[0], state[5]
 
         return decode
@@ -206,6 +216,14 @@ class GenerationEngine:
         if gen_key not in self._decode_fn:
             self._decode_fn[gen_key] = jax.jit(self._make_decode(gen_key))
         return self._decode_fn[gen_key]
+
+    def _get_stream_decode(self, chunk_key):
+        key = ("stream", chunk_key)
+        if key not in self._decode_fn:
+            self._decode_fn[key] = jax.jit(
+                self._make_decode(chunk_key, carry=True)
+            )
+        return self._decode_fn[key]
 
     def _get_batch_decode(self, lanes: int, gen_key):
         """vmap of the single-sequence decode over `lanes` rows. JAX's
@@ -261,6 +279,33 @@ class GenerationEngine:
         p = list(prompt)
         return p[-max_prompt:] if len(p) > max_prompt else p
 
+    def _prefill_and_sample_first(self, prompt_tokens, gen_key, seed):
+        """Shared prompt->first-token path for generate/generate_stream:
+        trim, bucket, prefill, sample token #1. Returns (first_token,
+        caches, counts, rng, prompt_len, first_is_stop)."""
+        max_new = gen_key[0]
+        prompt = self._trim_prompt(prompt_tokens, max_new)
+        length = len(prompt)
+        bucket = min(_bucket_len(length), self.max_context)
+        ids = np.zeros((1, bucket), dtype=np.int32)
+        ids[0, :length] = prompt
+
+        first_logits, caches = self._prefill_fn(bucket)(
+            self.params, jnp.asarray(ids), jnp.asarray(length, jnp.int32)
+        )
+        counts = jnp.zeros((first_logits.shape[-1],), jnp.int32)
+        rng = jax.random.key(
+            seed if seed is not None else (time.time_ns() & 0xFFFFFFFF)
+        )
+        rng, first_rng = jax.random.split(rng)
+        first_token = sample_token(
+            first_rng, first_logits[0], counts,
+            temperature=gen_key[1], top_k=gen_key[2], top_p=gen_key[3],
+            repetition_penalty=gen_key[4],
+        ).astype(jnp.int32)
+        first_is_stop = int(first_token) in self._stop_set
+        return first_token, caches, counts, rng, length, first_is_stop
+
     # -- public API --------------------------------------------------------
     def generate(
         self,
@@ -279,28 +324,9 @@ class GenerationEngine:
         max_new = gen_key[0]
 
         t0 = time.time()
-        prompt = self._trim_prompt(prompt_tokens, max_new)
-        length = len(prompt)
-        bucket = min(_bucket_len(length), self.max_context)
-        ids = np.zeros((1, bucket), dtype=np.int32)
-        ids[0, :length] = prompt
-
-        first_logits, caches = self._prefill_fn(bucket)(
-            self.params, jnp.asarray(ids), jnp.asarray(length, jnp.int32)
+        first_token, caches, counts, rng, length, first_is_stop = (
+            self._prefill_and_sample_first(prompt_tokens, gen_key, seed)
         )
-
-        counts = jnp.zeros((first_logits.shape[-1],), jnp.int32)
-        rng = jax.random.key(
-            seed if seed is not None else (time.time_ns() & 0xFFFFFFFF)
-        )
-        rng, first_rng = jax.random.split(rng)
-        first_token = sample_token(
-            first_rng, first_logits[0], counts,
-            temperature=gen_key[1], top_k=gen_key[2], top_p=gen_key[3],
-            repetition_penalty=gen_key[4],
-        ).astype(jnp.int32)
-
-        first_is_stop = int(first_token) in self._stop_set
         if first_is_stop or max_new <= 1:
             # A stop token is dropped; a normal token under a 1-token
             # budget is a valid result that exhausted the length.
@@ -333,6 +359,82 @@ class GenerationEngine:
             "stopped": "eos" if bool(hit_stop) else "length",
         }
         return tokens, stats
+
+    def generate_stream(
+        self,
+        prompt_tokens: Sequence[int],
+        max_new_tokens: Optional[int] = None,
+        temperature: Optional[float] = None,
+        top_p: Optional[float] = None,
+        top_k: Optional[int] = None,
+        repetition_penalty: Optional[float] = None,
+        seed: Optional[int] = None,
+        chunk_tokens: int = 8,
+    ):
+        """Yield generated token ids as they decode (SSE serving path).
+
+        Chunked re-entry into the jitted decode loop: every `chunk_tokens`
+        tokens the carry (rng/token/caches/counts) round-trips to host and
+        the new tokens are yielded. The rng splits once per iteration
+        inside the loop, so the stream is bit-identical to generate() with
+        the same seed. The final yield is a stats dict (same schema as
+        generate's), distinguishable because every other yield is an int.
+        """
+        gen_key = self._resolve_gen_key(
+            max_new_tokens, temperature, top_p, top_k, repetition_penalty
+        )
+        max_new = gen_key[0]
+        chunk = max(1, int(chunk_tokens))
+        t0 = time.time()
+        first_token, caches, counts, rng, length, first_is_stop = (
+            self._prefill_and_sample_first(prompt_tokens, gen_key, seed)
+        )
+        produced = 0
+        stopped = "length"
+        if not first_is_stop:
+            yield int(first_token)
+            produced = 1
+        if first_is_stop or max_new <= 1:
+            stopped = "eos" if first_is_stop else "length"
+        else:
+            token = first_token
+            counts = counts.at[token].add(1)
+            # One compile per gen params (chunk size is fixed); the tail
+            # chunk may over-decode up to chunk-1 iterations, trimmed to
+            # the budget below so tokens AND the stopped status match
+            # generate()'s single-loop semantics exactly.
+            chunk_key = (chunk + 1,) + gen_key[1:]
+            fn = self._get_stream_decode(chunk_key)
+            budget_iters = max_new - 1  # prefill already produced token #1
+            offset = 0  # decode iterations done (= cache slots past prompt)
+            while offset < budget_iters:
+                out, n, done, rng, token, caches, counts = fn(
+                    self.params, rng, token, caches, counts,
+                    jnp.asarray(length + offset, jnp.int32),
+                    jnp.asarray(False),
+                )
+                n = int(n)
+                if n <= 0:
+                    break
+                within = min(n, budget_iters - offset)
+                fresh = [
+                    t for t in np.asarray(out)[:within].tolist() if t >= 0
+                ]
+                for t in fresh:
+                    yield int(t)
+                produced += len(fresh)
+                if bool(done) and n <= budget_iters - offset:
+                    stopped = "eos"
+                    break
+                offset += n
+        dt = time.time() - t0
+        yield {
+            "tokens_generated": produced,
+            "seconds": round(dt, 3),
+            "tokens_per_second": round(produced / max(dt, 1e-9), 1),
+            "prompt_tokens": length,
+            "stopped": stopped,
+        }
 
     def generate_batch(
         self,

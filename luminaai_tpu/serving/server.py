@@ -19,6 +19,12 @@ Endpoints:
                            {"message": str} → {"reply", ...}
   POST /v1/auth           {"user","password"} → {"token"} (secure mode)
 
+Both generation endpoints accept {"stream": true} and then respond as
+text/event-stream: one `data: {"token", "delta"}` frame per generated
+token, a final `data: {"done": true, <text|reply>, tokens, latency_s,
+stopped}` frame, and a `data: [DONE]` terminator (engine.generate_stream's
+chunked decode; scripts/serve_load.py drives both modes under load).
+
 No flask/fastapi in the image — http.server keeps the component
 dependency-free and testable in-process.
 """
@@ -236,22 +242,23 @@ class ChatServer:
         "top_k": lambda v, _: max(0, min(int(v), 10_000)),
     }
 
-    def _run_model(self, path: str, body: Dict[str, Any]) -> tuple:
+    def _parse_request(self, path: str, body: Dict[str, Any]):
+        """Shared request parsing for the batched and streaming paths.
+
+        Returns (error_tuple | None, prompt_ids, overrides, reply_key)."""
         overrides = {}
         for k, clamp in self._OVERRIDE_CLAMPS.items():
             if k in body:
                 try:
                     overrides[k] = clamp(body[k], self.max_new_tokens_cap)
                 except (TypeError, ValueError):
-                    return 400, {"error": f"bad value for {k}"}
-        tok = self.engine.tokenizer
-        t0 = time.time()
+                    return (400, {"error": f"bad value for {k}"}), None, None, None
         if path == "/v1/chat":
             messages = body.get("messages")
             if not messages:
                 msg = str(body.get("message", ""))
                 if not msg:
-                    return 400, {"error": "message(s) required"}
+                    return (400, {"error": "message(s) required"}), None, None, None
                 messages = [{"role": "user", "content": msg}]
             for m in messages:
                 if (
@@ -259,18 +266,29 @@ class ChatServer:
                     or not isinstance(m.get("role"), str)
                     or not isinstance(m.get("content"), str)
                 ):
-                    return 400, {
-                        "error": "each message needs string "
-                                 "'role' and 'content'"
-                    }
+                    return (
+                        400,
+                        {
+                            "error": "each message needs string "
+                                     "'role' and 'content'"
+                        },
+                    ), None, None, None
             prompt_ids = self.engine.encode_chat(messages)
             reply_key = "reply"
         else:
             prompt = str(body.get("prompt", ""))
             if not prompt:
-                return 400, {"error": "prompt required"}
-            prompt_ids = tok.backend.encode(prompt)
+                return (400, {"error": "prompt required"}), None, None, None
+            prompt_ids = self.engine.tokenizer.backend.encode(prompt)
             reply_key = "text"
+        return None, prompt_ids, overrides, reply_key
+
+    def _run_model(self, path: str, body: Dict[str, Any]) -> tuple:
+        t0 = time.time()
+        err, prompt_ids, overrides, reply_key = self._parse_request(path, body)
+        if err is not None:
+            return err
+        tok = self.engine.tokenizer
         # Concurrent requests with the same sampling params ride one
         # batched decode (MicroBatcher); sampling overrides go as generate
         # kwargs, so there is no config mutation to serialize.
@@ -286,6 +304,80 @@ class ChatServer:
             stopped=stats.get("stopped"),
         )
         return 200, out
+
+    # -- streaming (SSE) ---------------------------------------------------
+    def start_stream(self, path: str, body: Dict[str, Any],
+                     token: Optional[str]):
+        """Begin a streamed generation. Returns (error_tuple | None,
+        events_generator | None). Streaming runs the engine's chunked
+        decode directly (one stream per request thread) rather than the
+        MicroBatcher — each stream owns its decode cadence; batched SSE
+        would couple every client's latency to the slowest stream."""
+        with self.state_lock:
+            err = self._gate(body, token)
+        if err is not None:
+            return err, None
+        if not hasattr(self.engine, "generate_stream"):
+            return (501, {"error": "engine does not support streaming"}), None
+        err, prompt_ids, overrides, reply_key = self._parse_request(path, body)
+        if err is not None:
+            return err, None
+        return None, self._stream_events(prompt_ids, overrides, reply_key)
+
+    def _stream_events(self, prompt_ids, overrides, reply_key):
+        """Yield SSE event dicts: {'token','delta'} per token, then a
+        final {'done': True, <reply_key>: full_text, ...stats}.
+
+        Deltas decode only the tokens since the last clean flush (O(1)
+        amortized, not a full re-decode per token); a decode ending
+        mid-codepoint (trailing U+FFFD from a split multi-byte char) is
+        HELD — the empty delta is emitted now and the held tokens flush
+        with the next clean boundary, so concatenated deltas reproduce
+        the final text instead of baking replacement chars in. The done
+        frame's text is authoritative (one decode of all tokens).
+        Aborted streams (client gone -> GeneratorExit) still count their
+        streamed tokens into /stats via the finally block."""
+        t0 = time.time()
+        tok = self.engine.tokenizer
+        tokens: List[int] = []
+        base = 0  # tokens[:base] are flushed into deltas already
+        counted = False
+
+        def count(n: int) -> None:
+            nonlocal counted
+            if counted:
+                return
+            counted = True
+            with self.state_lock:
+                self.requests += 1
+                self.tokens_out += n
+
+        try:
+            for item in self.engine.generate_stream(prompt_ids, **overrides):
+                if isinstance(item, dict):  # final stats yield
+                    count(int(item.get("tokens_generated", 0)))
+                    yield {
+                        "done": True,
+                        reply_key: tok.decode(tokens),
+                        "tokens": int(item.get("tokens_generated", 0)),
+                        "latency_s": round(time.time() - t0, 3),
+                        "stopped": item.get("stopped"),
+                    }
+                    return
+                tokens.append(int(item))
+                delta = tok.decode(tokens[base:])
+                if delta and (
+                    not delta.endswith("�")
+                    # A genuinely invalid byte would hold forever — flush
+                    # after 4 held tokens (a UTF-8 codepoint spans ≤4).
+                    or len(tokens) - base >= 4
+                ):
+                    base = len(tokens)
+                else:
+                    delta = ""
+                yield {"token": int(item), "delta": delta}
+        finally:
+            count(len(tokens))
 
     # -- socket layer ------------------------------------------------------
     def make_handler(self):
@@ -315,6 +407,40 @@ class ChatServer:
                 )
                 self._reply(code, payload)
 
+            def _reply_sse(self, events) -> None:
+                """Server-sent events: one `data: <json>` frame per event,
+                closing with `data: [DONE]` (the OpenAI-style stream
+                terminator clients already know how to parse)."""
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                try:
+                    for ev in events:
+                        self.wfile.write(
+                            b"data: " + json.dumps(ev).encode() + b"\n\n"
+                        )
+                        self.wfile.flush()
+                    self.wfile.write(b"data: [DONE]\n\n")
+                except (BrokenPipeError, ConnectionResetError):
+                    logger.info("stream client disconnected")
+                    events.close()  # stop decoding for a gone client
+                except Exception as e:
+                    # Headers are already sent: a raised-through error
+                    # would make do_POST write a second status line into
+                    # the open SSE body. Emit an error frame instead.
+                    logger.exception("stream failed mid-flight")
+                    try:
+                        self.wfile.write(
+                            b"data: "
+                            + json.dumps({"error": str(e)}).encode()
+                            + b"\n\ndata: [DONE]\n\n"
+                        )
+                    except OSError:
+                        pass
+                    events.close()
+
             def do_POST(self):
                 try:
                     n = int(self.headers.get("Content-Length", 0))
@@ -327,10 +453,22 @@ class ChatServer:
                 except (ValueError, json.JSONDecodeError) as e:
                     self._reply(400, {"error": f"bad request: {e}"})
                     return
+                path = self.path.split("?", 1)[0]
                 try:
+                    if (
+                        body.get("stream")
+                        and path in ("/v1/generate", "/v1/chat")
+                    ):
+                        err, events = server.start_stream(
+                            path, body, self._token()
+                        )
+                        if err is not None:
+                            self._reply(*err)
+                        else:
+                            self._reply_sse(events)
+                        return
                     code, payload = server.handle(
-                        "POST", self.path.split("?", 1)[0], body,
-                        self._token(),
+                        "POST", path, body, self._token()
                     )
                 except Exception as e:  # surface as 500, keep serving
                     logger.exception("request failed")

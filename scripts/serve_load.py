@@ -1,0 +1,210 @@
+#!/usr/bin/env python
+"""Serving load test: N concurrent clients through the MicroBatcher +
+streaming path, reporting p50/p95/p99 latency and aggregate throughput.
+
+Default mode spins an in-process server on a tiny real model (debug-scale
+LuminaTransformer + real GenerationEngine, so the numbers exercise the
+actual jitted prefill/decode), then drives it over real HTTP sockets.
+Point --url at a running `lumina serve` instance to load-test a real
+deployment instead.
+
+Usage:
+  python scripts/serve_load.py [--clients 8] [--requests 4] [--url URL]
+                               [--max-new 16] [--stream-smoke]
+
+Output: one human table + one JSON line (machine-consumable, mirrors the
+bench.py artifact style).
+"""
+import argparse
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_local_server():
+    import jax
+    import jax.numpy as jnp
+
+    from luminaai_tpu.config import Config
+    from luminaai_tpu.data.tokenizer import ConversationTokenizer
+    from luminaai_tpu.inference.generate import GenerationEngine
+    from luminaai_tpu.models.transformer import LuminaTransformer
+    from luminaai_tpu.serving.server import ChatServer
+
+    cfg = Config(
+        vocab_size=512, hidden_size=128, num_layers=2, num_heads=4,
+        num_kv_heads=2, seq_length=256, batch_size=2,
+        use_flash_attention=False, gradient_checkpointing=False,
+        max_new_tokens=16,
+    )
+    model = LuminaTransformer(cfg)
+    params = model.init(jax.random.key(0), jnp.ones((1, 16), jnp.int32))[
+        "params"
+    ]
+    tok = ConversationTokenizer(model_name="byte")
+    engine = GenerationEngine(model, params, tok, config=cfg)
+    srv = ChatServer(engine, max_batch=8, batch_window_ms=25.0)
+    from http.server import ThreadingHTTPServer
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), srv.make_handler())
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return f"http://127.0.0.1:{httpd.server_address[1]}", httpd
+
+
+def post(url, path, body, timeout=120):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def get(url, path, timeout=30):
+    with urllib.request.urlopen(url + path, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def run_load(url, clients, requests, max_new):
+    lat, toks, errors = [], [], []
+    lock = threading.Lock()
+
+    def client(i):
+        for j in range(requests):
+            body = {
+                "prompt": f"load test client {i} request {j} lorem ipsum",
+                "max_new_tokens": max_new,
+            }
+            t0 = time.time()
+            try:
+                code, out = post(url, "/v1/generate", body)
+                dt = time.time() - t0
+                with lock:
+                    if code == 200:
+                        lat.append(dt)
+                        toks.append(int(out.get("tokens", 0)))
+                    else:
+                        errors.append(code)
+            except Exception as e:  # noqa: BLE001 - record, keep loading
+                with lock:
+                    errors.append(str(e)[:80])
+
+    t0 = time.time()
+    threads = [
+        threading.Thread(target=client, args=(i,)) for i in range(clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.time() - t0
+    return lat, toks, errors, wall
+
+
+def stream_smoke(url, max_new):
+    """One streamed request; returns (n_token_frames, ttft_s, total_s)."""
+    body = json.dumps(
+        {"prompt": "stream me", "max_new_tokens": max_new, "stream": True}
+    ).encode()
+    req = urllib.request.Request(
+        url + "/v1/generate", data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    t0 = time.time()
+    ttft = None
+    n = 0
+    with urllib.request.urlopen(req, timeout=120) as r:
+        assert r.headers.get("Content-Type", "").startswith(
+            "text/event-stream"
+        ), r.headers.get("Content-Type")
+        for raw in r:
+            line = raw.decode().strip()
+            if not line.startswith("data: ") or line == "data: [DONE]":
+                continue
+            ev = json.loads(line[len("data: "):])
+            if "token" in ev:
+                if ttft is None:
+                    ttft = time.time() - t0
+                n += 1
+    return n, ttft or 0.0, time.time() - t0
+
+
+def pct(xs, p):
+    if not xs:
+        return None
+    return round(statistics.quantiles(xs, n=100)[p - 1], 3) if len(xs) > 1 \
+        else round(xs[0], 3)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--requests", type=int, default=4)
+    ap.add_argument("--max-new", type=int, default=16)
+    ap.add_argument("--url", default=None,
+                    help="target a running server instead of in-process")
+    ap.add_argument("--no-stream-smoke", action="store_true")
+    args = ap.parse_args()
+
+    url = args.url
+    httpd = None
+    if url is None:
+        url, httpd = build_local_server()
+        print(f"in-process server on {url}")
+
+    # Warmup (compiles the decode loop once).
+    post(url, "/v1/generate", {"prompt": "warmup", "max_new_tokens": 4})
+
+    lat, toks, errors, wall = run_load(
+        url, args.clients, args.requests, args.max_new
+    )
+    stats = get(url, "/stats")
+    stream = None
+    if not args.no_stream_smoke:
+        n, ttft, total = stream_smoke(url, args.max_new)
+        stream = {"frames": n, "ttft_s": round(ttft, 3),
+                  "total_s": round(total, 3)}
+
+    n_ok = len(lat)
+    result = {
+        "metric": "serve_p50_latency_s",
+        "value": pct(lat, 50),
+        "unit": "seconds",
+        "extras": {
+            "clients": args.clients,
+            "requests_per_client": args.requests,
+            "ok": n_ok,
+            "errors": errors[:5],
+            "p95_s": pct(lat, 95),
+            "p99_s": pct(lat, 99),
+            "wall_s": round(wall, 2),
+            "req_per_s": round(n_ok / max(wall, 1e-9), 2),
+            "agg_tokens_per_s": round(sum(toks) / max(wall, 1e-9), 1),
+            "batches": stats.get("batches"),
+            "max_batch_seen": stats.get("max_batch_seen"),
+            "stream_smoke": stream,
+        },
+    }
+    print(
+        f"ok {n_ok}  p50 {result['value']}s  "
+        f"p95 {result['extras']['p95_s']}s  "
+        f"req/s {result['extras']['req_per_s']}  "
+        f"agg tok/s {result['extras']['agg_tokens_per_s']}  "
+        f"max_batch {result['extras']['max_batch_seen']}"
+    )
+    print(json.dumps(result))
+    if httpd is not None:
+        httpd.shutdown()
+        httpd.server_close()
+    if errors:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

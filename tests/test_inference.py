@@ -89,6 +89,25 @@ def test_generate_deterministic_with_seed(setup):
     assert t1 == t2
 
 
+def test_generate_stream_matches_generate(setup):
+    """Chunked streaming decode is bit-identical to the single-loop
+    generate() for the same seed (the rng splits once per iteration in
+    both), across chunk sizes that divide and straddle the budget."""
+    engine, tok, _, _, _ = setup
+    prompt = tok.encode_text("stream parity")
+    for chunk, mnt, seed in ((4, 12, 0), (5, 12, 9), (16, 6, 3), (1, 3, 1)):
+        ref, rstats = engine.generate(prompt, max_new_tokens=mnt, seed=seed)
+        events = list(
+            engine.generate_stream(
+                prompt, max_new_tokens=mnt, seed=seed, chunk_tokens=chunk
+            )
+        )
+        stats = events[-1]
+        assert events[:-1] == ref, (chunk, mnt, seed)
+        assert stats["tokens_generated"] == len(ref)
+        assert stats["stopped"] == rstats["stopped"]
+
+
 def test_generate_matches_no_cache_forward(setup):
     """Greedy decode with KV cache must match argmax of a full forward."""
     engine, tok, cfg, model, params = setup

@@ -38,9 +38,8 @@ class FakeEngine:
         self.batch_sizes = []
 
     def generate(self, prompt_tokens, **kw):
-        return list(prompt_tokens)[:3], {
-            "tokens_generated": 3, "stopped": "eos",
-        }
+        toks = list(prompt_tokens)[:3]
+        return toks, {"tokens_generated": len(toks), "stopped": "eos"}
 
     def generate_batch(self, prompts, **kw):
         self.batch_sizes.append(len(prompts))
@@ -52,6 +51,11 @@ class FakeEngine:
     def chat_response(self, messages):
         reply, stats = self.generate(self.encode_chat(messages))
         return self.tokenizer.decode(reply), stats
+
+    def generate_stream(self, prompt_tokens, **kw):
+        toks, stats = self.generate(prompt_tokens, **kw)
+        yield from toks
+        yield stats
 
 
 @pytest.fixture()
@@ -92,14 +96,14 @@ def test_health(server_url):
 
 def test_generate_and_stats(server_url):
     url, srv = server_url
-    code, body = _post(url, "/v1/generate", {"prompt": "hi"})
+    code, body = _post(url, "/v1/generate", {"prompt": "hiya"})
     assert code == 200 and body["text"].startswith("tok:")
     assert body["tokens"] == 3
     code, body = _post(url, "/v1/chat", {"message": "yo"})
     # Chat rides the same batched path: encode_chat -> generate -> decode.
     assert code == 200 and body["reply"] == "tok:121,111"
     code, body = _get(url, "/stats")
-    assert body["requests"] == 2 and body["tokens_out"] == 6
+    assert body["requests"] == 2 and body["tokens_out"] == 5
 
 
 def test_bad_requests(server_url):
@@ -153,6 +157,153 @@ class TestSecure:
         token = body["token"]
         code, body = _post(url, "/v1/chat", {"message": "   "}, token=token)
         assert code == 400
+
+
+def _post_sse(url, path, body, timeout=10):
+    """POST with stream:true; return (content_type, list of data frames)."""
+    req = urllib.request.Request(
+        url + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        ctype = r.headers.get("Content-Type", "")
+        raw = r.read().decode()
+    frames = [
+        line[len("data: "):]
+        for line in raw.split("\n")
+        if line.startswith("data: ")
+    ]
+    return ctype, frames
+
+
+def test_streaming_sse(server_url):
+    """stream:true responds as text/event-stream: one token frame per
+    generated token (deltas concatenate to the final text), a done frame
+    with the same schema as the non-streaming reply, then [DONE]."""
+    url, srv = server_url
+    _, ref = _post(url, "/v1/generate", {"prompt": "hi"})
+    ctype, frames = _post_sse(url, "/v1/generate",
+                              {"prompt": "hi", "stream": True})
+    assert ctype.startswith("text/event-stream")
+    assert frames[-1] == "[DONE]"
+    events = [json.loads(f) for f in frames[:-1]]
+    toks = [e for e in events if "token" in e]
+    done = events[-1]
+    assert done.get("done") is True
+    assert len(toks) == done["tokens"] == ref["tokens"]
+    assert done["text"] == ref["text"]
+    assert done["stopped"] == ref["stopped"]
+    # /v1/chat streams with the reply key.
+    _, frames = _post_sse(url, "/v1/chat",
+                          {"message": "yo", "stream": True})
+    done = json.loads(frames[-2])
+    assert done["done"] is True and done["reply"].startswith("tok:")
+    # Stats count streamed requests/tokens too (3 requests: the non-stream
+    # reference + two streams; "hi"→2 tokens ×2 + "yo"→2 tokens).
+    _, stats = _get(url, "/stats")
+    assert stats["requests"] >= 3 and stats["tokens_out"] >= 6
+
+
+def test_streaming_errors(server_url):
+    url, _ = server_url
+    code, body = _post(url, "/v1/generate", {"stream": True})  # no prompt
+    assert code == 400
+
+
+def test_streaming_multibyte_delta_hold():
+    """A multi-byte codepoint split across tokens must not bake U+FFFD
+    into the delta stream: the partial decode is held and flushed at the
+    next clean boundary, so concatenated deltas == final text."""
+
+    class ByteTokenizerBackend:
+        def encode(self, text):
+            return list(text.encode())
+
+    class ByteTokenizer:
+        backend = ByteTokenizerBackend()
+
+        def decode(self, tokens):
+            return bytes(tokens).decode("utf-8", errors="replace")
+
+    class ByteEngine(FakeEngine):
+        def __init__(self):
+            super().__init__()
+            self.tokenizer = ByteTokenizer()
+
+        def generate_stream(self, prompt_tokens, **kw):
+            out = list("héllo".encode())  # é = 2 bytes, split mid-stream
+            yield from out
+            yield {"tokens_generated": len(out), "stopped": "eos"}
+
+    srv = ChatServer(ByteEngine())
+    events = list(srv._stream_events([1], {}, "text"))
+    done = events[-1]
+    deltas = "".join(e["delta"] for e in events[:-1])
+    assert done["text"] == "héllo"
+    assert deltas == done["text"]
+    # The held frame emitted an empty delta, not a replacement char.
+    assert all("�" not in e["delta"] for e in events[:-1])
+
+
+def test_streaming_midflight_error_emits_error_frame(server_url):
+    """An engine exception after frames have been sent must surface as an
+    SSE error frame + [DONE], never a second HTTP status line inside the
+    open stream body."""
+
+    class ExplodingEngine(FakeEngine):
+        def generate_stream(self, prompt_tokens, **kw):
+            yield int(prompt_tokens[0])
+            raise RuntimeError("device fell over")
+
+    srv = ChatServer(ExplodingEngine())
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), srv.make_handler())
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        ctype, frames = _post_sse(url, "/v1/generate",
+                                  {"prompt": "x", "stream": True})
+        assert ctype.startswith("text/event-stream")
+        assert frames[-1] == "[DONE]"
+        err = json.loads(frames[-2])
+        assert "device fell over" in err["error"]
+        json.loads(frames[0])  # the pre-error token frame is parseable
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_aborted_stream_still_counted():
+    """Closing the event generator early (client disconnect) still books
+    the streamed tokens into /stats."""
+    srv = ChatServer(FakeEngine())
+    gen = srv._stream_events([1, 2, 3, 4], {}, "text")
+    next(gen)
+    next(gen)
+    gen.close()
+    assert srv.requests == 1
+    assert srv.tokens_out == 2
+
+
+def test_streaming_unsupported_engine():
+    eng = FakeEngine()
+    del type(eng).generate_stream  # class attr removal affects this type
+    try:
+        srv = ChatServer(eng)
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), srv.make_handler())
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        code, body = _post(url, "/v1/generate",
+                           {"prompt": "x", "stream": True})
+        assert code == 501
+        httpd.shutdown()
+        httpd.server_close()
+    finally:
+        FakeEngine.generate_stream = _FAKE_STREAM_BACKUP
+
+
+_FAKE_STREAM_BACKUP = FakeEngine.generate_stream
 
 
 def test_override_clamps(server_url):
