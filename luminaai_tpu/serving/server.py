@@ -127,6 +127,40 @@ class MicroBatcher:
                     item[3].set()
 
 
+class _SlotStream:
+    """Event-stream wrapper that releases its concurrency slot exactly
+    once — on exhaustion, error, or close(). A plain generator's finally
+    block never runs if the generator is closed before its first next()
+    (e.g. the handler's header write fails for an already-gone client),
+    which would slowly leak stream slots into permanent 503s."""
+
+    def __init__(self, inner, release):
+        self._inner = inner
+        self._release = release
+        self._released = False
+
+    def _release_once(self) -> None:
+        if not self._released:
+            self._released = True
+            self._release()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self._inner)
+        except BaseException:
+            self._release_once()  # StopIteration included
+            raise
+
+    def close(self) -> None:
+        try:
+            self._inner.close()
+        finally:
+            self._release_once()
+
+
 class ChatServer:
     """Owns the engine + optional security stack; builds the handler class."""
 
@@ -139,11 +173,17 @@ class ChatServer:
         max_new_tokens_cap: int = 2048,
         max_batch: int = 8,
         batch_window_ms: float = 15.0,
+        max_streams: int = 4,
     ):
         self.engine = engine
         self.batcher = MicroBatcher(
             engine, max_batch=max_batch, window_ms=batch_window_ms
         )
+        # Streams bypass the MicroBatcher, so each holds its own KV cache
+        # + decode loop on the device; unlike the single-worker batched
+        # path they'd be unbounded without a cap (ThreadingHTTPServer is
+        # thread-per-connection).
+        self._stream_slots = threading.Semaphore(max(1, int(max_streams)))
         # Auth/limiter/counter state is shared across handler threads;
         # SecurityManager and RateLimiter are not thread-safe themselves.
         self.state_lock = threading.Lock()
@@ -322,7 +362,15 @@ class ChatServer:
         err, prompt_ids, overrides, reply_key = self._parse_request(path, body)
         if err is not None:
             return err, None
-        return None, self._stream_events(prompt_ids, overrides, reply_key)
+        if not self._stream_slots.acquire(blocking=False):
+            return (
+                503,
+                {"error": "too many concurrent streams; retry shortly"},
+            ), None
+        return None, _SlotStream(
+            self._stream_events(prompt_ids, overrides, reply_key),
+            self._stream_slots.release,
+        )
 
     def _stream_events(self, prompt_ids, overrides, reply_key):
         """Yield SSE event dicts: {'token','delta'} per token, then a
@@ -334,9 +382,12 @@ class ChatServer:
         HELD — the empty delta is emitted now and the held tokens flush
         with the next clean boundary, so concatenated deltas reproduce
         the final text instead of baking replacement chars in. The done
-        frame's text is authoritative (one decode of all tokens).
+        frame's text is authoritative (one decode of all tokens), and it
+        carries a final 'delta' flushing any still-held tokens so the
+        delta contract survives a stream that ENDS mid-codepoint.
         Aborted streams (client gone -> GeneratorExit) still count their
-        streamed tokens into /stats via the finally block."""
+        streamed tokens into /stats via the finally block, which also
+        releases the concurrency slot acquired in start_stream."""
         t0 = time.time()
         tok = self.engine.tokenizer
         tokens: List[int] = []
@@ -359,6 +410,13 @@ class ChatServer:
                     yield {
                         "done": True,
                         reply_key: tok.decode(tokens),
+                        # Flush tokens still held by the mid-codepoint
+                        # delta hold (empty when the stream ended clean).
+                        "delta": (
+                            tok.decode(tokens[base:])
+                            if base < len(tokens)
+                            else ""
+                        ),
                         "tokens": int(item.get("tokens_generated", 0)),
                         "latency_s": round(time.time() - t0, 3),
                         "stopped": item.get("stopped"),

@@ -10,7 +10,7 @@ deployment instead.
 
 Usage:
   python scripts/serve_load.py [--clients 8] [--requests 4] [--url URL]
-                               [--max-new 16] [--stream-smoke]
+                               [--max-new 16] [--no-stream-smoke]
 
 Output: one human table + one JSON line (machine-consumable, mirrors the
 bench.py artifact style).

@@ -273,6 +273,67 @@ def test_streaming_midflight_error_emits_error_frame(server_url):
         httpd.server_close()
 
 
+def test_stream_concurrency_cap():
+    """Streams bypass the MicroBatcher, so a slot semaphore caps them:
+    over the limit → 503; slots release on completion AND on a close
+    before the first event (the leak path)."""
+    import time as _time
+
+    class SlowEngine(FakeEngine):
+        def generate_stream(self, prompt_tokens, **kw):
+            yield 1
+            _time.sleep(0.5)
+            yield 2
+            yield {"tokens_generated": 2, "stopped": "eos"}
+
+    srv = ChatServer(SlowEngine(), max_streams=1)
+    err1, ev1 = srv.start_stream("/v1/generate", {"prompt": "a"}, None)
+    assert err1 is None
+    err2, ev2 = srv.start_stream("/v1/generate", {"prompt": "b"}, None)
+    assert err2 is not None and err2[0] == 503
+    # Closing BEFORE the first next() must still release the slot.
+    ev1.close()
+    err3, ev3 = srv.start_stream("/v1/generate", {"prompt": "c"}, None)
+    assert err3 is None
+    # Draining to exhaustion releases too.
+    list(ev3)
+    err4, ev4 = srv.start_stream("/v1/generate", {"prompt": "d"}, None)
+    assert err4 is None
+    ev4.close()
+
+
+def test_stream_tail_flush_on_done_frame():
+    """A stream ending mid-codepoint flushes the held tokens as the done
+    frame's delta, so concatenated deltas still reproduce the text."""
+
+    class ByteTokenizerBackend:
+        def encode(self, text):
+            return list(text.encode())
+
+    class ByteTokenizer:
+        backend = ByteTokenizerBackend()
+
+        def decode(self, tokens):
+            return bytes(tokens).decode("utf-8", errors="replace")
+
+    class TruncatedEngine(FakeEngine):
+        def __init__(self):
+            super().__init__()
+            self.tokenizer = ByteTokenizer()
+
+        def generate_stream(self, prompt_tokens, **kw):
+            out = list("hé".encode())[:-1] + [0xC3]  # ends mid-codepoint
+            yield from out
+            yield {"tokens_generated": len(out), "stopped": "length"}
+
+    srv = ChatServer(TruncatedEngine())
+    events = list(srv._stream_events([1], {}, "text"))
+    done = events[-1]
+    deltas = "".join(e["delta"] for e in events)
+    assert done["text"] == deltas  # tail flushed via done frame's delta
+    assert done["delta"] != ""
+
+
 def test_aborted_stream_still_counted():
     """Closing the event generator early (client disconnect) still books
     the streamed tokens into /stats."""
