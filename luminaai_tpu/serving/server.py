@@ -460,8 +460,24 @@ class ChatServer:
             def do_GET(self):
                 # Health probes often add query strings (cache busting);
                 # route on the bare path.
+                path = self.path.split("?", 1)[0]
+                if path in ("/", "/chat"):
+                    # Built-in chat page (the ref's Electron app role —
+                    # serving/webui.py). Static: auth gates the API calls
+                    # the page makes, not the page itself.
+                    from luminaai_tpu.serving.webui import PAGE
+
+                    data = PAGE.encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/html; charset=utf-8"
+                    )
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
                 code, payload = server.handle(
-                    "GET", self.path.split("?", 1)[0], {}, self._token()
+                    "GET", path, {}, self._token()
                 )
                 self._reply(code, payload)
 
@@ -469,12 +485,16 @@ class ChatServer:
                 """Server-sent events: one `data: <json>` frame per event,
                 closing with `data: [DONE]` (the OpenAI-style stream
                 terminator clients already know how to parse)."""
-                self.send_response(200)
-                self.send_header("Content-Type", "text/event-stream")
-                self.send_header("Cache-Control", "no-cache")
-                self.send_header("Connection", "close")
-                self.end_headers()
                 try:
+                    # Header writes live INSIDE the try: a client gone
+                    # before headers raises BrokenPipeError, and the
+                    # handler below must still events.close() or the
+                    # stream slot leaks (permanent 503s at the cap).
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/event-stream")
+                    self.send_header("Cache-Control", "no-cache")
+                    self.send_header("Connection", "close")
+                    self.end_headers()
                     for ev in events:
                         self.wfile.write(
                             b"data: " + json.dumps(ev).encode() + b"\n\n"

@@ -87,6 +87,16 @@ def _get(url, path):
         return r.status, json.loads(r.read())
 
 
+def test_chat_page_served(server_url):
+    """GET / serves the built-in chat UI (the ref Electron app's role)."""
+    url, _ = server_url
+    with urllib.request.urlopen(url + "/", timeout=10) as r:
+        assert r.status == 200
+        assert r.headers.get("Content-Type", "").startswith("text/html")
+        page = r.read().decode()
+    assert "/v1/chat" in page and "text/event-stream" in page
+
+
 def test_health(server_url):
     url, _ = server_url
     code, body = _get(url, "/health")
