@@ -1,0 +1,48 @@
+# Serving/training image for luminaai_tpu (ref Dockerfile.backend:1 — its
+# Flask-on-:5001 backend image; this one serves the same contract via
+# `lumina serve`). Build with the default BASE for CPU smoke; on a TPU VM
+# pass the jax[tpu] extra so the libtpu wheel matches the host driver:
+#
+#   docker build -t lumina-tpu .
+#   docker build --build-arg JAX_EXTRA="jax[tpu]" \
+#       --build-arg PIP_EXTRA_INDEX="-f https://storage.googleapis.com/jax-releases/libtpu_releases.html" \
+#       -t lumina-tpu .
+#   docker run -p 5001:5001 -v /ckpts:/ckpts lumina-tpu \
+#       lumina serve --checkpoint /ckpts/run1 --host 0.0.0.0
+FROM python:3.11-slim AS base
+
+ENV PYTHONDONTWRITEBYTECODE=1 \
+    PYTHONUNBUFFERED=1 \
+    DEBIAN_FRONTEND=noninteractive
+
+# g++ builds the native helpers (data packer, BPE merge loop) on demand.
+RUN apt-get update && apt-get install -y --no-install-recommends \
+    curl g++ \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+
+ARG JAX_EXTRA="jax"
+ARG PIP_EXTRA_INDEX=""
+
+# Heavy dependencies in their own layer (pyproject floors), so a source
+# edit doesn't re-download the JAX stack on rebuild.
+RUN pip install --upgrade pip \
+    && pip install ${PIP_EXTRA_INDEX} "${JAX_EXTRA}" \
+        "flax>=0.8" "optax>=0.2" "orbax-checkpoint>=0.5" "numpy>=1.24"
+COPY pyproject.toml README.md ./
+COPY luminaai_tpu ./luminaai_tpu
+RUN pip install -e . --no-deps
+
+RUN mkdir -p /ckpts /data /logs
+
+# Same port as the reference backend contract (docker-compose.dev.yml:12).
+EXPOSE 5001
+
+HEALTHCHECK --interval=30s --timeout=5s --start-period=120s \
+    CMD curl -fsS http://127.0.0.1:5001/health || exit 1
+
+# Checkpoint auto-discovery searches the working directory, so run from
+# the mount point: any run directory mounted under /ckpts is found.
+WORKDIR /ckpts
+CMD ["lumina", "serve", "--host", "0.0.0.0", "--port", "5001"]

@@ -1,0 +1,36 @@
+# Development makefile (ref makefile:1 — its desktop dev commands; these
+# target the TPU framework's actual workflows).
+.PHONY: help install test test-fast bench bench-ops dryrun serve load docker
+
+PY ?= python
+
+help: ## Show available commands
+	@grep -E '^[a-zA-Z_-]+:.*?## .*$$' $(MAKEFILE_LIST) | sort | \
+	  awk 'BEGIN {FS = ":.*?## "}; {printf "%-12s %s\n", $$1, $$2}'
+
+install: ## Editable install with the lumina console script
+	pip install -e .[dev]
+
+test-fast: ## Fast test tier (CPU, ~10 min) — what CI runs on push
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m "not slow"
+
+test: ## Full suite (includes 8-device mesh parity + e2e trains)
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q
+
+bench: ## Driver-contract benchmark (one JSON line)
+	$(PY) bench.py
+
+bench-ops: ## Op-level microbenchmarks
+	$(PY) bench_ops.py
+
+dryrun: ## 8-device multichip sharding dry run (virtual CPU mesh)
+	$(PY) __graft_entry__.py 8
+
+serve: ## Serve the latest checkpoint found under . (API + chat UI at /)
+	lumina serve
+
+load: ## Serving load test against an in-process tiny model
+	JAX_PLATFORMS=cpu $(PY) scripts/serve_load.py
+
+docker: ## Build the serving image
+	docker build -t lumina-tpu .
