@@ -365,11 +365,9 @@ class Config:
                 f"attention_window must be positive, got "
                 f"{self.attention_window}"
             )
-            assert not self.use_ring_attention, (
-                "attention_window does not compose with ring attention "
-                "(the ring already partitions the sequence; windowed "
-                "ring attention is not implemented)"
-            )
+            # Composes with ring attention (r5): the ring body masks the
+            # global band, skips whole out-of-band chunks, and merges the
+            # far-edge straddling chunk by lse (ops/ring_attention.py).
         assert self.lr_scheduler in LR_SCHEDULES, (
             f"invalid lr_scheduler {self.lr_scheduler}"
         )

@@ -358,11 +358,13 @@ class GQAttention(nn.Module):
                     causal=True,
                     block_q=min(cfg.flash_block_q, S),
                     block_kv=min(cfg.flash_block_kv, S),
+                    window=cfg.attention_window,
                 )
             else:
                 out = _ring_attention_shard(
                     q, k, v, axis_name="sequence", axis_size=sp,
                     causal=True,
+                    window=cfg.attention_window,
                 )
             y = _out_proj(out)
             return y, new_cache
@@ -398,6 +400,7 @@ class GQAttention(nn.Module):
                     use_flash=cfg.use_flash_attention,
                     block_q=cfg.flash_block_q,
                     block_kv=cfg.flash_block_kv,
+                    window=cfg.attention_window,
                 )
                 y = _out_proj(out)
                 return y, new_cache

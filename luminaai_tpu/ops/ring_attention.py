@@ -39,22 +39,28 @@ from jax.sharding import Mesh, PartitionSpec
 NEG_INF = -1e30
 
 
-def _chunk_update(qg, k, v, kv_idx, m, l, o, *, my_idx, sl_q, causal, scale):
+def _chunk_update(
+    qg, k, v, kv_idx, m, l, o, *, my_idx, sl_q, causal, scale, window=None
+):
     """One online-softmax accumulation step against a single K/V chunk.
 
     qg: [B, Sq, Hkv, G, D] queries (grouped for GQA)
     k, v: [B, Skv, Hkv, D] current ring chunk
     kv_idx: scalar ring index of the chunk's home device (global offset)
     m, l, o: running max / sum / output accumulators (fp32)
+    window: sliding-window width (global q_pos - k_pos < window), or None
     """
     logits = (
         jnp.einsum("bqhgd,bkhd->bqhgk", qg, k).astype(jnp.float32) * scale
     )
-    if causal:
+    if causal or window is not None:
         sk = k.shape[1]
         q_pos = my_idx * sl_q + jnp.arange(sl_q)
         k_pos = kv_idx * sk + jnp.arange(sk)
-        mask = q_pos[:, None] >= k_pos[None, :]
+        diff = q_pos[:, None] - k_pos[None, :]
+        mask = diff >= 0 if causal else jnp.ones_like(diff, bool)
+        if window is not None:
+            mask = jnp.logical_and(mask, diff < window)
         logits = jnp.where(mask[None, :, None, None, :], logits, NEG_INF)
     m_new = jnp.maximum(m, logits.max(axis=-1))
     corr = jnp.exp(m - m_new)
@@ -75,17 +81,25 @@ def _ring_attention_shard_flash(
     causal: bool,
     block_q: int,
     block_kv: int,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Flash-kernel ring body: each chunk runs the Pallas kernel (MXU-tiled,
     no [Sq, Skv] logits in HBM) and returns (out, lse); chunks merge with
     the online-softmax recurrence. Causal structure is per-chunk-static:
-    ring step 0 is always the diagonal (causal kernel); later steps are
-    either fully visible (flash, causal=False) or fully masked — the masked
-    case SKIPS the kernel via lax.cond, saving the whole chunk's FLOPs.
+    ring step 0 is always the diagonal (causal kernel, window passed through
+    to its banded grids); later steps are fully visible (flash,
+    causal=False), fully masked/out-of-window (SKIP the kernel via
+    lax.switch, saving the whole chunk's FLOPs — with a window that is
+    every chunk past ceil(W/Sl) ring steps), or straddle the window's far
+    edge (einsum chunk with the global band mask, merged by lse like any
+    other chunk — the Pallas kernel has no offset-band grid).
     """
     from luminaai_tpu.ops.flash_attention import flash_attention_with_lse
 
     B, Sl, Hq, D = q.shape
+    Hkv = k.shape[2]
+    G = Hq // Hkv
+    scale = 1.0 / (D**0.5)
     my_idx = jax.lax.axis_index(axis_name)
 
     def merge(acc, den, m, o_c, lse_c):
@@ -99,9 +113,11 @@ def _ring_attention_shard_flash(
         acc = acc * corr_t + o_c.astype(jnp.float32) * w_t
         return acc, den, m_new
 
-    # Step 0: always the diagonal chunk (own K/V) — causal within.
+    # Step 0: always the diagonal chunk (own K/V) — causal within; the
+    # kernel's banded grids handle an intra-chunk window natively.
     o_c, lse_c = flash_attention_with_lse(
-        q, k, v, causal=causal, block_q=block_q, block_kv=block_kv
+        q, k, v, causal=causal, block_q=block_q, block_kv=block_kv,
+        window=window,
     )
     acc = jnp.zeros((B, Sl, Hq, D), jnp.float32)
     den = jnp.zeros((B, Hq, Sl), jnp.float32)
@@ -113,12 +129,7 @@ def _ring_attention_shard_flash(
         k = jax.lax.ppermute(k, axis_name, perm)
         v = jax.lax.ppermute(v, axis_name, perm)
         kv_idx = (my_idx - step) % axis_size
-
-        def attend(ops):
-            q_, k_, v_ = ops
-            return flash_attention_with_lse(
-                q_, k_, v_, causal=False, block_q=block_q, block_kv=block_kv
-            )
+        offset = (my_idx - kv_idx) * Sl  # q_pos - k_pos at matching rows
 
         def skip(ops):
             return (
@@ -126,12 +137,74 @@ def _ring_attention_shard_flash(
                 jnp.full((B, Hq, Sl), NEG_INF, jnp.float32),
             )
 
+        @jax.checkpoint
+        def banded(ops):
+            # Offset-band einsum chunk: mask 0 <= q_pos - k_pos < window
+            # globally, return per-chunk-normalized (out, lse). Rows whose
+            # whole band misses this chunk get lse = -inf (weight ~0 in
+            # the merge). Checkpointed like the einsum ring's update: the
+            # backward re-computes the [Sl, Sl] logits instead of storing
+            # them per ring step — without this, the one straddle chunk
+            # would reintroduce the quadratic HBM flash ring avoids.
+            q_, k_, v_ = ops
+            qg = q_.reshape(B, Sl, Hkv, G, D)
+            logits = (
+                jnp.einsum("bqhgd,bkhd->bqhgk", qg, k_).astype(jnp.float32)
+                * scale
+            )
+            diff = offset + jnp.arange(Sl)[:, None] - jnp.arange(Sl)[None, :]
+            mask = jnp.logical_and(diff >= 0, diff < window)
+            logits = jnp.where(
+                mask[None, :, None, None, :], logits, NEG_INF
+            )
+            m_c = logits.max(axis=-1)                       # [B,Sl,Hkv,G]
+            p = jnp.exp(logits - m_c[..., None])
+            l_c = p.sum(axis=-1)
+            # l_c >= 1 always (the argmax entry is exp(0)); masked-out
+            # rows are harmless because m_c = NEG_INF dominates their lse,
+            # but pin them to NEG_INF explicitly so the merge weight is an
+            # exact zero rather than exp(NEG_INF + log(Sl) - m).
+            any_row = mask.any(axis=-1)[None, :, None, None]
+            o_row = jnp.einsum(
+                "bqhgk,bkhd->bqhgd", p.astype(v_.dtype), v_
+            ).astype(jnp.float32) / l_c[..., None]
+            lse = jnp.where(any_row, m_c + jnp.log(l_c), NEG_INF)
+            o_out = o_row.reshape(B, Sl, Hq, D).astype(q.dtype)
+            lse_out = lse.reshape(B, Sl, Hq).transpose(0, 2, 1)
+            return o_out, lse_out
+
+        def attend(ops):
+            q_, k_, v_ = ops
+            return flash_attention_with_lse(
+                q_, k_, v_, causal=False, block_q=block_q, block_kv=block_kv
+            )
+
         if causal:
-            o_c, lse_c = jax.lax.cond(kv_idx > my_idx, skip, attend, (q, k, v))
+            if window is None:
+                o_c, lse_c = jax.lax.cond(
+                    kv_idx > my_idx, skip, attend, (q, k, v)
+                )
+            else:
+                # 0 = skip (future chunk or band fully past), 2 = fully
+                # inside the band (plain kernel), 1 = straddles the far
+                # edge (banded einsum).
+                out_of_band = jnp.logical_or(
+                    kv_idx > my_idx, offset - (Sl - 1) >= window
+                )
+                fully_in = jnp.logical_and(
+                    kv_idx < my_idx, offset + (Sl - 1) < window
+                )
+                idx = jnp.where(out_of_band, 0, jnp.where(fully_in, 2, 1))
+                o_c, lse_c = jax.lax.switch(
+                    idx, [skip, banded, attend], (q, k, v)
+                )
         else:
             o_c, lse_c = attend((q, k, v))
         acc, den, m = merge(acc, den, m, o_c, lse_c)
 
+    # With a window, rows can exist whose band lies entirely in earlier
+    # chunks only — impossible under causal+diagonal (diff 0 is always in
+    # band), so den > 0 holds whenever window >= 1.
     return (acc / den.transpose(0, 2, 1)[..., None]).astype(q.dtype)
 
 
@@ -143,8 +216,15 @@ def _ring_attention_shard(
     axis_name: str,
     axis_size: int,
     causal: bool,
+    window: Optional[int] = None,
 ) -> jax.Array:
-    """Per-shard body (inside shard_map). q: [B, Sl, Hq, D]; k/v: [B, Sl, Hkv, D]."""
+    """Per-shard body (inside shard_map). q: [B, Sl, Hq, D]; k/v: [B, Sl, Hkv, D].
+
+    window: sliding-window width in global positions. Chunks entirely
+    outside the band (or entirely in the causal future) skip their matmuls
+    via lax.cond — the ring rotation still runs every step so shards stay
+    in lockstep, but with a window the compute per device drops from
+    O(S·S/sp) to O(S·W/sp + S·Sl/sp)."""
     B, Sl, Hq, D = q.shape
     Hkv = k.shape[2]
     G = Hq // Hkv
@@ -158,14 +238,31 @@ def _ring_attention_shard(
 
     update = jax.checkpoint(
         functools.partial(
-            _chunk_update, my_idx=my_idx, sl_q=Sl, causal=causal, scale=scale
+            _chunk_update, my_idx=my_idx, sl_q=Sl, causal=causal,
+            scale=scale, window=window,
         )
     )
     # Rotation: after s permutes, device i holds the chunk born on (i - s) % n.
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
     for step in range(axis_size):
         kv_idx = (my_idx - step) % axis_size
-        m, l, o = update(qg, k, v, kv_idx, m, l, o)
+        if causal and step > 0:
+            # Whole-chunk skip: the chunk is in the causal future, or (with
+            # a window) even its NEAREST pair q_pos - k_pos = offset-(Sl-1)
+            # is already past the band.
+            proc = kv_idx < my_idx
+            if window is not None:
+                offset = (my_idx - kv_idx) * Sl
+                proc = jnp.logical_and(proc, offset - (Sl - 1) < window)
+
+            m, l, o = jax.lax.cond(
+                proc,
+                lambda ops: update(*ops),
+                lambda ops: (ops[4], ops[5], ops[6]),
+                (qg, k, v, kv_idx, m, l, o),
+            )
+        else:
+            m, l, o = update(qg, k, v, kv_idx, m, l, o)
         if step + 1 < axis_size:
             k = jax.lax.ppermute(k, axis_name, perm)
             v = jax.lax.ppermute(v, axis_name, perm)
@@ -187,6 +284,7 @@ def ring_attention(
     use_flash: bool = False,
     block_q: int = 512,
     block_kv: int = 512,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Sequence-parallel attention over `axis_name` of `mesh`.
 
@@ -201,6 +299,12 @@ def ring_attention(
     """
     from luminaai_tpu.ops.flash_attention import flash_eligible
 
+    if window is not None and use_flash and not causal:
+        raise ValueError(
+            "windowed ring attention is causal-only on the flash path "
+            "(the Pallas banded grids assume causality); use "
+            "use_flash=False for a non-causal window"
+        )
     axis_size = mesh.shape[axis_name]
     if q_spec is None:
         q_spec = PartitionSpec(("data", "fsdp"), axis_name, None, None)
@@ -218,6 +322,7 @@ def ring_attention(
             causal=causal,
             block_q=min(block_q, local_len),
             block_kv=min(block_kv, local_len),
+            window=window,
         )
     else:
         fn = functools.partial(
@@ -225,6 +330,7 @@ def ring_attention(
             axis_name=axis_name,
             axis_size=axis_size,
             causal=causal,
+            window=window,
         )
     sharded = jax.shard_map(
         fn,
