@@ -67,9 +67,11 @@ class Config:
     # the `attention_window` most recent positions (itself included).
     # None = full causal. The flash kernels skip whole blocks outside the
     # band, so long-context attention cost becomes O(S·W) instead of
-    # O(S²); decode masks the KV cache to the same window. A TPU-first
-    # capability beyond the reference's surface (its attention is always
-    # full causal).
+    # O(S²); ring sequence parallelism masks/skips the same band across
+    # shards; decode runs a ROLLING KV cache (slot = pos % C, C ≈ W) so
+    # serving cache HBM is O(window) instead of O(max_context). A
+    # TPU-first capability beyond the reference's surface (its attention
+    # is always full causal).
     attention_window: Optional[int] = None
 
     # --- MoE ---

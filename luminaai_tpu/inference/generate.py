@@ -141,7 +141,14 @@ class GenerationEngine:
                 1, self.max_context,
                 kv_cache_dtype=getattr(self.config, "kv_cache_dtype", None),
             )
-            positions = jnp.arange(prompt_bucket)[None, :]
+            # Padding rows carry position -1 so the rolling-cache scatter
+            # (attention_window) can tell live prompt rows from bucket
+            # padding — padding written as if it were positions
+            # length..bucket-1 would clobber in-band slots once the
+            # bucket exceeds the slot count. Harmless otherwise: padding
+            # K/V is masked (or overwritten) on every cache layout.
+            pos = jnp.arange(prompt_bucket)
+            positions = jnp.where(pos < length, pos, -1)[None, :]
             logits, caches, _ = self.model.apply(
                 {"params": params},
                 ids,

@@ -284,7 +284,12 @@ class GQAttention(nn.Module):
         if kv_cache is not None:
             ck0 = kv_cache[0]
             # int8 caches are (codes, scales) pairs; bf16 are plain arrays.
-            max_len = (ck0[0] if isinstance(ck0, tuple) else ck0).shape[1]
+            cache_len = (ck0[0] if isinstance(ck0, tuple) else ck0).shape[1]
+            # A rolling (windowed) cache is slot-count-sized, not
+            # position-sized — positions still run to config.seq_length
+            # (init_cache only rolls when max_context fits it), so the
+            # table covers the larger of the two.
+            max_len = max(cfg.seq_length, S, cache_len)
         else:
             max_len = max(cfg.seq_length, S)
         cos, sin = rope_frequencies(d, max_len, cfg.rope_theta)
@@ -293,8 +298,52 @@ class GQAttention(nn.Module):
         k = apply_rope(k, cos, sin, positions, compute_dtype=rope_ct)
 
         new_cache = None
+        rolling_prefill = False
         if kv_cache is not None:
             ck, cv = kv_cache
+            C_cache = (ck[0] if isinstance(ck, tuple) else ck).shape[1]
+            windowed = cfg.attention_window is not None
+            # The cache is ROLLING only when init_cache actually shrank it
+            # below the position span (see init_cache); otherwise slot ==
+            # position and every plain-layout path below applies.
+            rolling = windowed and C_cache < max(cfg.seq_length, S)
+            # Rolling-cache write index: slot = pos % C; decode wraps.
+            if rolling and S == 1:
+                write_at = jnp.mod(cache_index, C_cache)
+            else:
+                write_at = cache_index
+
+            if rolling and S > 1:
+                # Prefill into a rolling cache: LIVE rows land at
+                # pos % C with last-C-wins over live positions (earlier
+                # prompt rows are out of every future token's band).
+                # Liveness comes from the caller's positions: the engine
+                # marks bucket-padding rows with position -1 — scattering
+                # padding as if it were real trailing positions would
+                # clobber in-band slots whenever the padded bucket
+                # exceeds the slot count. Per-batch-row indices support
+                # ragged vmapped prefill lanes. The dummy slot C absorbs
+                # discarded rows; assumes prefill overwrites a fresh
+                # cache (the generation engine's only multi-row write).
+                if positions is None:
+                    live = jnp.broadcast_to(jnp.arange(S) < S, (B, S))
+                    pos_live = jnp.broadcast_to(jnp.arange(S), (B, S))
+                else:
+                    live = positions >= 0
+                    pos_live = jnp.where(live, positions, 0)
+                length_b = live.sum(axis=1, keepdims=True)  # [B, 1]
+                keep = jnp.logical_and(
+                    live, pos_live >= length_b - C_cache
+                )
+                idx = jnp.where(keep, pos_live % C_cache, C_cache)  # [B,S]
+                rows = jnp.arange(B)[:, None]
+
+                def _scatter(fresh):
+                    buf = jnp.zeros(
+                        (B, C_cache + 1, *fresh.shape[2:]), fresh.dtype
+                    )
+                    return buf.at[rows, idx].set(fresh)[:, :C_cache]
+
             if isinstance(ck, tuple):
                 # int8 KV cache (config.kv_cache_dtype='int8'): codes +
                 # per-row scales. Quantize the fresh rows at insert; read
@@ -306,28 +355,42 @@ class GQAttention(nn.Module):
                 def _upd(cache, fresh):
                     codes, scales = cache
                     q8, s = quantize_act(fresh)
-                    codes = jax.lax.dynamic_update_slice(
-                        codes, q8, (0, cache_index, 0, 0)
-                    )
-                    scales = jax.lax.dynamic_update_slice(
-                        scales, s, (0, cache_index, 0, 0)
-                    )
+                    if rolling and S > 1:
+                        codes, scales = _scatter(q8), _scatter(s)
+                    else:
+                        codes = jax.lax.dynamic_update_slice(
+                            codes, q8, (0, write_at, 0, 0)
+                        )
+                        scales = jax.lax.dynamic_update_slice(
+                            scales, s, (0, write_at, 0, 0)
+                        )
                     deq = (codes.astype(jnp.float32) * scales).astype(
                         self.dtype
                     )
                     return (codes, scales), deq
 
-                ck, k = _upd(ck, k)
-                cv, v = _upd(cv, v)
+                ck, k_att = _upd(ck, k)
+                cv, v_att = _upd(cv, v)
             else:
-                ck = jax.lax.dynamic_update_slice(
-                    ck, k, (0, cache_index, 0, 0)
-                )
-                cv = jax.lax.dynamic_update_slice(
-                    cv, v, (0, cache_index, 0, 0)
-                )
-                k, v = ck, cv
+                if rolling and S > 1:
+                    ck, cv = _scatter(k), _scatter(v)
+                else:
+                    ck = jax.lax.dynamic_update_slice(
+                        ck, k, (0, write_at, 0, 0)
+                    )
+                    cv = jax.lax.dynamic_update_slice(
+                        cv, v, (0, write_at, 0, 0)
+                    )
+                k_att, v_att = ck, cv
             new_cache = (ck, cv)
+            if rolling and S > 1:
+                # Rolling prefill attends the RAW rows (full banded
+                # self-attention over the prompt): the rolled cache is
+                # slot-ordered, not position-ordered, and only serves
+                # later decode steps.
+                rolling_prefill = True
+            else:
+                k, v = k_att, v_att
 
         q = nn.with_logical_constraint(
             q, ("activation_batch", "activation_length", "activation_heads", None)
@@ -407,9 +470,12 @@ class GQAttention(nn.Module):
 
         from luminaai_tpu.ops.flash_attention import flash_eligible
 
+        # Rolling prefill attends the raw prompt rows (see the cache
+        # block above), which is exactly the no-cache forward — so the
+        # banded flash kernel applies there too.
         use_flash = (
             cfg.use_flash_attention
-            and kv_cache is None
+            and (kv_cache is None or rolling_prefill)
             and flash_eligible(S, d, cfg.flash_block_q, cfg.flash_block_kv)
         )
         if use_flash:
@@ -425,7 +491,11 @@ class GQAttention(nn.Module):
                 window=cfg.attention_window,
             )
         else:
-            out = self._xla_attention(q, k, v, kv_cache is not None, cache_index)
+            out = self._xla_attention(
+                q, k, v,
+                kv_cache is not None and not rolling_prefill,
+                cache_index,
+            )
 
         y = _out_proj(out)
         return y, new_cache
@@ -449,10 +519,25 @@ class GQAttention(nn.Module):
         if decoding:
             q_pos = q_pos + cache_index
         k_pos = jnp.arange(Skv)[None, :]
-        mask = q_pos >= k_pos
         w = self.config.attention_window
-        if w is not None:
-            mask = jnp.logical_and(mask, q_pos - k_pos < w)
+        if (
+            decoding
+            and w is not None
+            and Skv < max(self.config.seq_length, Sq)
+        ):
+            # ROLLING-cache decode (cache smaller than the position
+            # span): slot s holds the freshest position
+            # p = t - ((t - s) mod C) of its residue class with p in
+            # [length - C, t] all live (length-aware prefill scatter +
+            # one write per decode step, each before its attend).
+            # back <= t ⇔ p >= 0 (covers causality); back < w is the
+            # band, and C >= w keeps every in-band position resident.
+            back = jnp.mod(q_pos - k_pos, Skv)
+            mask = jnp.logical_and(back <= q_pos, back < w)
+        else:
+            mask = q_pos >= k_pos
+            if w is not None:
+                mask = jnp.logical_and(mask, q_pos - k_pos < w)
         logits = jnp.where(mask[None, None, None], logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
         out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)

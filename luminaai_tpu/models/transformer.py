@@ -430,11 +430,24 @@ class LuminaTransformer(nn.Module):
         kv_cache_dtype overrides the model config's choice — the
         generation engine passes ITS config so a serving-time override
         (e.g. chat --kv-cache-dtype) doesn't depend on the model having
-        been built from the same mutable Config object."""
+        been built from the same mutable Config object.
+
+        With attention_window set, the cache is ROLLING: only
+        ceil(window/128)*128 slots are allocated (decode never attends
+        past the band, so slot `pos % C` holds the freshest key for its
+        residue class) — decode-cache HBM is O(window), not
+        O(max_context). GQAttention's slot arithmetic reduces to the
+        plain layout when the cache never wraps, so this is purely an
+        allocation decision. Skipped when max_len exceeds the config
+        sequence length (the RoPE table is sized by config.seq_length
+        once the cache no longer records absolute positions)."""
         cfg = self.config
         choice = kv_cache_dtype or cfg.kv_cache_dtype
         d = cfg.head_dim()
-        shape = (batch_size, max_len, cfg.num_kv_heads, d)
+        C = max_len
+        if cfg.attention_window is not None and max_len <= cfg.seq_length:
+            C = min(max_len, ((cfg.attention_window + 127) // 128) * 128)
+        shape = (batch_size, C, cfg.num_kv_heads, d)
 
         def one(lead):
             if choice == "int8":
