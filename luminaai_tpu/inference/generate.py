@@ -97,6 +97,77 @@ def _bucket_len(n: int, minimum: int = 64) -> int:
     return b
 
 
+def ngram_propose(
+    history: Sequence[int], k: int, max_ngram: int = 3
+) -> List[int]:
+    """Prompt-lookup draft: find the most recent earlier occurrence of the
+    history's trailing n-gram (longest n first) and propose the k tokens
+    that followed it. Zero model cost — the draft source is the text
+    itself, which is highly repetitive in the workloads speculative
+    decoding targets (code, structured chat, retrieval contexts). Returns
+    [] when no n-gram recurs.
+
+    Reference implementation (O(len·n) scan); the decode loop uses the
+    incremental _NgramIndex with identical proposals."""
+    h = list(history)
+    n_h = len(h)
+    for n in range(min(max_ngram, n_h - 1), 0, -1):
+        tail = h[n_h - n:]
+        # Scan right-to-left for the latest earlier match.
+        for i in range(n_h - n - 1, -1, -1):
+            if h[i:i + n] == tail:
+                cont = h[i + n: i + n + k]
+                if cont:
+                    return cont
+    return []
+
+
+class _NgramIndex:
+    """Incremental prompt-lookup index: each n-gram maps to its two most
+    recent end offsets, so per-round proposals are O(max_ngram) dict hits
+    instead of a full history rescan between device steps (the host-side
+    stall grows with context otherwise). Proposals match ngram_propose:
+    latest EARLIER occurrence, longest n first (the tail's own occurrence
+    is ent[0] with an empty continuation, so ent[1] supplies the match)."""
+
+    def __init__(self, history: Sequence[int], max_ngram: int = 3):
+        self.h: List[int] = list(history)
+        self.max_n = max_ngram
+        self.map: Dict[tuple, List[Optional[int]]] = {}
+        for end in range(1, len(self.h) + 1):
+            self._register(end)
+
+    def _register(self, end: int) -> None:
+        h = self.h
+        for n in range(1, self.max_n + 1):
+            if end - n < 0:
+                break
+            key = tuple(h[end - n:end])
+            ent = self.map.get(key)
+            if ent is None:
+                self.map[key] = [end, None]
+            elif ent[0] != end:
+                self.map[key] = [end, ent[0]]
+
+    def append(self, token: int) -> None:
+        self.h.append(token)
+        self._register(len(self.h))
+
+    def propose(self, k: int) -> List[int]:
+        h = self.h
+        L = len(h)
+        for n in range(min(self.max_n, L - 1), 0, -1):
+            ent = self.map.get(tuple(h[L - n:]))
+            if not ent:
+                continue
+            for end in ent:
+                if end is not None:
+                    cont = h[end:end + k]
+                    if cont:
+                        return cont
+        return []
+
+
 class GenerationEngine:
     """Single-sequence generation over a LuminaTransformer + params."""
 
@@ -285,6 +356,144 @@ class GenerationEngine:
         max_prompt = self.max_context - max_new - 1
         p = list(prompt)
         return p[-max_prompt:] if len(p) > max_prompt else p
+
+    def _get_verify(self, k: int):
+        """Jitted speculative-verification step: feed k tokens (the last
+        accepted token + k-1 drafted) at positions start..start+k-1 —
+        their cache rows are written in the same pass — and return the
+        greedy argmax at every fed position. One device call scores k
+        draft tokens; decode is HBM-bound, so the k-row forward costs
+        little more than an S=1 step."""
+        key = ("verify", k)
+        if key not in self._decode_fn:
+
+            def verify(params, ids, caches, start):
+                positions = (start + jnp.arange(k))[None, :]
+                logits, caches, _ = self.model.apply(
+                    {"params": params},
+                    ids,
+                    positions=positions,
+                    kv_caches=caches,
+                    cache_index=start,
+                    deterministic=True,
+                    multi_row_update=True,
+                )
+                return (
+                    jnp.argmax(logits[0], axis=-1).astype(jnp.int32),
+                    caches,
+                )
+
+            self._decode_fn[key] = jax.jit(verify)
+        return self._decode_fn[key]
+
+    def generate_speculative(
+        self,
+        prompt_tokens: Sequence[int],
+        max_new_tokens: Optional[int] = None,
+        draft_k: int = 8,
+        seed: Optional[int] = None,
+    ) -> Tuple[List[int], Dict[str, Any]]:
+        """Greedy decode with prompt-lookup (n-gram) speculative drafts.
+
+        Each round verifies up to draft_k-1 drafted tokens plus the model's
+        own next prediction in ONE k-row forward; accepted prefixes advance
+        multiple positions per device call. Output is exactly the plain
+        greedy generate() sequence (verification accepts a draft token only
+        when it IS the greedy choice given its true prefix). Greedy-only by
+        construction — temperature/top-p sampling would need rejection
+        resampling; use generate() for sampled decoding.
+
+        (The reference has no speculative path; its decode re-runs the
+        full model per token, Chat.py:346. This is a TPU-first serving
+        addition: decode is HBM-bound, so scoring k rows costs ~one step.)
+        """
+        max_new = int(max_new_tokens or self.config.max_new_tokens)
+        k = max(2, int(draft_k))
+        w = getattr(self.config, "attention_window", None)
+        if w is not None and self.max_context <= self.config.seq_length:
+            # Rolling cache: a k-row verify needs C - window >= k-1 slots
+            # of slack or later rows evict earlier rows' in-band keys
+            # (enforced at trace time in the attention layer). Cap the
+            # draft; with zero slack (window % 128 == 0) fall back to
+            # plain greedy decode.
+            slots = min(self.max_context, ((w + 127) // 128) * 128)
+            if slots < self.max_context:  # rolling actually engages
+                k = min(k, slots - w + 1)
+                if k < 2:
+                    return self.generate(
+                        prompt_tokens, max_new_tokens=max_new,
+                        temperature=0.0, repetition_penalty=1.0, seed=seed,
+                    )
+        gen_key = (max_new, 0.0, 0, 1.0, 1.0)  # greedy, no penalty
+        t0 = time.time()
+        # Trim leaves room for the verify overshoot (up to k-1 cache rows
+        # past the final token) so cache writes never clamp out of range.
+        prompt = self._trim_prompt(prompt_tokens, max_new + k)
+        first_token, caches, counts, rng, length, first_is_stop = (
+            self._prefill_and_sample_first(prompt, gen_key, seed)
+        )
+        del counts, rng  # greedy without penalty needs neither
+        verify_calls = 0
+        tokens: List[int] = []
+        stopped = "length"
+        if first_is_stop:
+            stopped = "eos"
+        elif max_new >= 1:
+            tokens = [int(first_token)]
+            index = _NgramIndex(list(prompt) + tokens)
+            verify = self._get_verify(k)
+            fn_stop = self._stop_set
+            pos = length  # next cache row to write
+            token = int(first_token)  # accepted, not yet fed
+            while len(tokens) < max_new:
+                draft = index.propose(k - 1)
+                ids = [token] + draft + [-1] * (k - 1 - len(draft))
+                nxt, caches = verify(
+                    self.params,
+                    jnp.asarray([ids], jnp.int32),
+                    caches,
+                    jnp.asarray(pos, jnp.int32),
+                )
+                nxt = np.asarray(nxt)
+                verify_calls += 1
+                # Accept drafted tokens while each IS the greedy choice
+                # given its (now verified) prefix, then take the model's
+                # own prediction at the divergence point as a bonus.
+                j = 0
+                while j < k - 1 and int(nxt[j]) == ids[j + 1]:
+                    j += 1
+                accepted = [int(ids[m + 1]) for m in range(j)] + [int(nxt[j])]
+                done = False
+                for t in accepted:
+                    if t in fn_stop:
+                        stopped = "eos"
+                        done = True
+                        break
+                    tokens.append(t)
+                    index.append(t)
+                    if len(tokens) >= max_new:
+                        done = True
+                        break
+                # Cache rows 0..j carried correct tokens; the next round
+                # re-feeds from pos+j+1, overwriting any stale drafted
+                # rows before they can be attended.
+                pos += j + 1
+                token = accepted[-1]
+                if done:
+                    break
+        dt = time.time() - t0
+        stats = {
+            "tokens_generated": len(tokens),
+            "seconds": round(dt, 3),
+            "tokens_per_second": round(len(tokens) / max(dt, 1e-9), 1),
+            "prompt_tokens": length,
+            "stopped": stopped,
+            "verify_calls": verify_calls,
+            "tokens_per_verify": round(
+                len(tokens) / max(verify_calls, 1), 2
+            ),
+        }
+        return tokens, stats
 
     def _prefill_and_sample_first(self, prompt_tokens, gen_key, seed):
         """Shared prompt->first-token path for generate/generate_stream:

@@ -183,6 +183,11 @@ class GQAttention(nn.Module):
 
     config: Config
     dtype: Dtype = jnp.bfloat16
+    # Static: this S>1 call writes MID-STREAM rows into an existing cache
+    # (speculative-decode verification) rather than prefilling a fresh
+    # one — a rolling cache then attends the cache with the slot mask
+    # (the whole band is resident) instead of the raw prompt rows.
+    multi_row_update: bool = False
 
     @nn.compact
     def __call__(
@@ -314,23 +319,30 @@ class GQAttention(nn.Module):
                 write_at = cache_index
 
             if rolling and S > 1:
-                # Prefill into a rolling cache: LIVE rows land at
-                # pos % C with last-C-wins over live positions (earlier
-                # prompt rows are out of every future token's band).
-                # Liveness comes from the caller's positions: the engine
-                # marks bucket-padding rows with position -1 — scattering
+                # Multi-row write into a rolling cache: LIVE rows land at
+                # pos % C with last-C-wins over live positions. Liveness
+                # comes from the caller's positions: the engine marks
+                # bucket-padding rows with position -1 — scattering
                 # padding as if it were real trailing positions would
                 # clobber in-band slots whenever the padded bucket
                 # exceeds the slot count. Per-batch-row indices support
-                # ragged vmapped prefill lanes. The dummy slot C absorbs
-                # discarded rows; assumes prefill overwrites a fresh
-                # cache (the generation engine's only multi-row write).
+                # ragged vmapped prefill lanes; the dummy slot C absorbs
+                # discarded rows. The scatter UPDATES the existing cache
+                # (untouched slots keep their content), so it serves both
+                # prefill (fresh zero cache — identical result) and
+                # mid-stream multi-row writes like speculative-decode
+                # verification, where K consecutive positions land at a
+                # time (all live, k <= C distinct slots).
                 if positions is None:
                     live = jnp.broadcast_to(jnp.arange(S) < S, (B, S))
                     pos_live = jnp.broadcast_to(jnp.arange(S), (B, S))
                 else:
                     live = positions >= 0
                     pos_live = jnp.where(live, positions, 0)
+                # Among THIS batch of rows, only the last C live ones can
+                # coexist in the cache (distinct slots). live.sum is the
+                # prompt length at prefill; for k-row mid-stream writes
+                # (k <= C) the bound is vacuous and every row keeps.
                 length_b = live.sum(axis=1, keepdims=True)  # [B, 1]
                 keep = jnp.logical_and(
                     live, pos_live >= length_b - C_cache
@@ -338,9 +350,10 @@ class GQAttention(nn.Module):
                 idx = jnp.where(keep, pos_live % C_cache, C_cache)  # [B,S]
                 rows = jnp.arange(B)[:, None]
 
-                def _scatter(fresh):
-                    buf = jnp.zeros(
-                        (B, C_cache + 1, *fresh.shape[2:]), fresh.dtype
+                def _scatter(cache_arr, fresh):
+                    buf = jnp.pad(
+                        cache_arr,
+                        ((0, 0), (0, 1)) + ((0, 0),) * (cache_arr.ndim - 2),
                     )
                     return buf.at[rows, idx].set(fresh)[:, :C_cache]
 
@@ -356,7 +369,8 @@ class GQAttention(nn.Module):
                     codes, scales = cache
                     q8, s = quantize_act(fresh)
                     if rolling and S > 1:
-                        codes, scales = _scatter(q8), _scatter(s)
+                        codes = _scatter(codes, q8)
+                        scales = _scatter(scales, s)
                     else:
                         codes = jax.lax.dynamic_update_slice(
                             codes, q8, (0, write_at, 0, 0)
@@ -373,7 +387,7 @@ class GQAttention(nn.Module):
                 cv, v_att = _upd(cv, v)
             else:
                 if rolling and S > 1:
-                    ck, cv = _scatter(k), _scatter(v)
+                    ck, cv = _scatter(ck, k), _scatter(cv, v)
                 else:
                     ck = jax.lax.dynamic_update_slice(
                         ck, k, (0, write_at, 0, 0)
@@ -383,11 +397,27 @@ class GQAttention(nn.Module):
                     )
                 k_att, v_att = ck, cv
             new_cache = (ck, cv)
-            if rolling and S > 1:
-                # Rolling prefill attends the RAW rows (full banded
-                # self-attention over the prompt): the rolled cache is
-                # slot-ordered, not position-ordered, and only serves
-                # later decode steps.
+            if rolling and S > 1 and self.multi_row_update:
+                # A k-row mid-stream write needs slack: row j's band must
+                # survive rows j+1..k-1 landing in later slots — without
+                # C - window >= k-1, the tail rows evict in-band slots of
+                # earlier rows and the slot mask silently reads future
+                # draft K/V as the evicted position (review-caught with
+                # window % 128 == 0, where slack is zero).
+                if S - 1 > C_cache - cfg.attention_window:
+                    raise ValueError(
+                        f"rolling-cache multi-row update of {S} rows "
+                        f"needs cache slack >= {S - 1} (cache {C_cache} "
+                        f"slots, window {cfg.attention_window}); reduce "
+                        "draft_k or use a non-multiple-of-128 window"
+                    )
+            if rolling and S > 1 and not self.multi_row_update:
+                # Rolling PREFILL attends the RAW rows (full banded
+                # self-attention over the prompt): early prompt rows may
+                # have been dropped from the slot-ordered cache, so the
+                # slot mask can't serve them. Mid-stream multi-row writes
+                # (multi_row_update) attend the cache instead — their
+                # whole band is resident by construction.
                 rolling_prefill = True
             else:
                 k, v = k_att, v_att

@@ -62,6 +62,7 @@ class TransformerBlock(nn.Module):
     dtype: Dtype = jnp.bfloat16
     # Static (module attribute, not call arg) so nn.remat never traces it.
     deterministic: bool = True
+    multi_row_update: bool = False  # see GQAttention.multi_row_update
 
     @nn.compact
     def __call__(
@@ -76,7 +77,10 @@ class TransformerBlock(nn.Module):
         deterministic = self.deterministic
         metrics: Dict[str, jax.Array] = {}
 
-        h, new_cache = GQAttention(cfg, dtype=self.dtype, name="attention")(
+        h, new_cache = GQAttention(
+            cfg, dtype=self.dtype,
+            multi_row_update=self.multi_row_update, name="attention",
+        )(
             RMSNorm(cfg.rms_norm_eps, dtype=self.dtype, name="attn_norm")(x),
             positions=positions,
             kv_cache=kv_cache,
@@ -184,6 +188,7 @@ class _ScanUnit(nn.Module):
     offsets: Tuple[int, ...]
     dtype: Dtype = jnp.bfloat16
     deterministic: bool = True
+    multi_row_update: bool = False
 
     @nn.compact
     def __call__(self, x, caches, positions, cache_index):
@@ -195,6 +200,7 @@ class _ScanUnit(nn.Module):
                 layer_idx=self.start_layer + off,
                 dtype=self.dtype,
                 deterministic=self.deterministic,
+                multi_row_update=self.multi_row_update,
                 name=f"block_{j}",
             )(
                 x,
@@ -245,6 +251,7 @@ class LuminaTransformer(nn.Module):
         deterministic: bool = True,
         return_hidden: bool = False,
         prefix_embeds: Optional[jax.Array] = None,
+        multi_row_update: bool = False,
     ):
         cfg = self.config
         embedder = Embedder(cfg, dtype=self.dtype, name="embedder")
@@ -279,7 +286,7 @@ class LuminaTransformer(nn.Module):
         if cfg.scan_layers:
             x, new_caches, all_metrics = self._apply_scanned(
                 x, positions, kv_caches, cache_index, deterministic,
-                remat_on, policy,
+                remat_on, policy, multi_row_update,
             )
         else:
             block_cls = TransformerBlock
@@ -305,6 +312,7 @@ class LuminaTransformer(nn.Module):
                     layer_idx=i,
                     dtype=self.dtype,
                     deterministic=deterministic,
+                    multi_row_update=multi_row_update,
                     name=f"layer_{i}",
                 )(
                     x,
@@ -339,7 +347,7 @@ class LuminaTransformer(nn.Module):
 
     def _apply_scanned(
         self, x, positions, kv_caches, cache_index, deterministic,
-        remat_on, policy,
+        remat_on, policy, multi_row_update=False,
     ):
         """`nn.scan` over homogeneous layer segments (see scan_segments).
 
@@ -375,6 +383,7 @@ class LuminaTransformer(nn.Module):
                 offsets=offsets,
                 dtype=self.dtype,
                 deterministic=deterministic,
+                multi_row_update=multi_row_update,
                 name=f"scan_{s}",
             )(x, seg_caches, positions, cache_index)
             if decoding:

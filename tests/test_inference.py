@@ -231,6 +231,117 @@ def test_rolling_window_cache_matches_no_cache_forward(kv_dtype):
     assert tokens == expect
 
 
+def test_ngram_propose():
+    from luminaai_tpu.inference.generate import ngram_propose
+
+    h = [1, 2, 3, 9, 1, 2, 3]
+    assert ngram_propose(h, 2) == [9, 1]  # trigram [1,2,3] recurs
+    assert ngram_propose([5, 6, 7], 4) == []  # nothing recurs
+    # Latest earlier occurrence wins.
+    h2 = [1, 2, 8, 1, 2, 9, 1, 2]
+    assert ngram_propose(h2, 1) == [9]
+
+
+@pytest.mark.parametrize("window", [None, 100])
+def test_speculative_matches_greedy(setup, window):
+    """Prompt-lookup speculative decode emits EXACTLY the plain greedy
+    sequence — on a repetitive prompt (drafts hit, several tokens per
+    verify) and a non-repetitive one (drafts miss, degenerates to ~1
+    token per call) — including through a rolling windowed cache (the
+    multi_row_update slot path)."""
+    engine, tok, cfg, model, params = setup
+    if window is not None:
+        import dataclasses as dc
+
+        cfg2 = dc.replace(cfg, attention_window=window, seq_length=512)
+        model2 = LuminaTransformer(cfg2)
+        engine = GenerationEngine(model2, params, tok, cfg2)
+    reps = tok.encode_text("the quick brown fox jumps " * 12)
+    rand = tok.encode_text("zebra quilt ophid 93 xylem&")
+    for prompt in (reps, rand):
+        ref, _ = engine.generate(
+            prompt, max_new_tokens=24, temperature=0.0, seed=0,
+            repetition_penalty=1.0,
+        )
+        spec, stats = engine.generate_speculative(
+            prompt, max_new_tokens=24, draft_k=6, seed=0
+        )
+        assert spec == ref, (stats, spec, ref)
+        assert stats["verify_calls"] >= 1
+    # The repetitive prompt must actually amortize: fewer device calls
+    # than tokens (the random model's output may or may not repeat, but
+    # the prompt itself gives the n-gram proposer material).
+    spec, stats = engine.generate_speculative(
+        reps, max_new_tokens=24, draft_k=6, seed=0
+    )
+    if len(spec) >= 8:
+        assert stats["verify_calls"] < len(spec), stats
+
+
+def test_ngram_index_matches_reference():
+    """The incremental index proposes exactly what the O(n²) reference
+    scan proposes, across random and repetitive sequences and as tokens
+    append."""
+    from luminaai_tpu.inference.generate import _NgramIndex, ngram_propose
+
+    rng = np.random.RandomState(0)
+    for trial in range(20):
+        h = list(rng.randint(0, 6, size=rng.randint(2, 40)))
+        idx = _NgramIndex(h)
+        for step in range(10):
+            assert idx.propose(4) == ngram_propose(idx.h, 4), (
+                trial, step, idx.h
+            )
+            t = int(rng.randint(0, 6))
+            idx.append(t)
+
+
+@pytest.mark.parametrize("window", [128, 228])
+def test_speculative_rolling_zero_and_tight_slack(setup, window):
+    """The slot-collision regimes review found: window=128 gives ZERO
+    cache slack (C == window) — speculation must fall back to plain
+    greedy decode; window=228 gives 28 slots of slack — the draft is
+    capped and the sequence must still be exact through a wrapping
+    cache (prompt + generation run well past the slot count)."""
+    import dataclasses as dc
+
+    engine, tok, cfg, model, params = setup
+    cfg2 = dc.replace(cfg, attention_window=window, seq_length=512)
+    model2 = LuminaTransformer(cfg2)
+    eng = GenerationEngine(model2, params, tok, cfg2)
+    prompt = tok.encode_text("the quick brown fox jumps over " * 14)
+    assert len(prompt) > 256  # wraps even the 256-slot cache
+    ref, _ = eng.generate(
+        prompt, max_new_tokens=24, temperature=0.0, seed=0,
+        repetition_penalty=1.0,
+    )
+    spec, stats = eng.generate_speculative(
+        prompt, max_new_tokens=24, draft_k=8, seed=0
+    )
+    assert spec == ref, (window, stats, spec, ref)
+    if window == 128:
+        # Zero slack: the plain-generate fallback has no verify stats.
+        assert "verify_calls" not in stats
+    else:
+        assert stats["verify_calls"] >= 1
+
+
+def test_speculative_stops_on_eos(setup):
+    """A drafted-and-accepted stop token ends generation without being
+    emitted, matching generate()'s semantics."""
+    engine, tok, _, _, _ = setup
+    prompt = tok.encode_text("hello world " * 8)
+    ref, rstats = engine.generate(
+        prompt, max_new_tokens=64, temperature=0.0, seed=0,
+        repetition_penalty=1.0,
+    )
+    spec, sstats = engine.generate_speculative(
+        prompt, max_new_tokens=64, draft_k=8, seed=0
+    )
+    assert spec == ref
+    assert sstats["stopped"] == rstats["stopped"]
+
+
 def test_chat_response_roundtrip(setup):
     engine, tok, _, _, _ = setup
     text, stats = engine.chat_response(
