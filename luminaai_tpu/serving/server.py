@@ -280,6 +280,7 @@ class ChatServer:
         "temperature": lambda v, _: min(max(float(v), 0.0), 10.0),
         "top_p": lambda v, _: min(max(float(v), 0.0), 1.0),
         "top_k": lambda v, _: max(0, min(int(v), 10_000)),
+        "repetition_penalty": lambda v, _: min(max(float(v), 0.5), 5.0),
     }
 
     def _parse_request(self, path: str, body: Dict[str, Any]):
@@ -328,12 +329,25 @@ class ChatServer:
         err, prompt_ids, overrides, reply_key = self._parse_request(path, body)
         if err is not None:
             return err
-        tok = self.engine.tokenizer
+        if body.get("speculative"):
+            out = self._run_speculative(
+                prompt_ids, overrides, reply_key, t0
+            )
+            if out is not None:
+                return out
+            # Not eligible (sampling params / engine support): fall
+            # through to the batched path silently — speculation is an
+            # accelerator hint, not a contract.
         # Concurrent requests with the same sampling params ride one
         # batched decode (MicroBatcher); sampling overrides go as generate
         # kwargs, so there is no config mutation to serialize.
         tokens, stats = self.batcher.submit(prompt_ids, overrides)
-        out = {reply_key: tok.decode(tokens)}
+        return self._reply_payload(tokens, stats, reply_key, t0)
+
+    def _reply_payload(self, tokens, stats, reply_key, t0, **extra) -> tuple:
+        """Shared response building + stats booking for the batched and
+        speculative generation paths."""
+        out = {reply_key: self.engine.tokenizer.decode(tokens)}
         n_tok = int(stats.get("tokens_generated", 0))
         with self.state_lock:
             self.requests += 1
@@ -342,8 +356,52 @@ class ChatServer:
             tokens=n_tok,
             latency_s=round(time.time() - t0, 3),
             stopped=stats.get("stopped"),
+            **extra,
         )
         return 200, out
+
+    def _run_speculative(self, prompt_ids, overrides, reply_key, t0):
+        """Greedy requests with {"speculative": true} run the engine's
+        prompt-lookup speculative decode (exactly the greedy sequence,
+        several tokens per device call on repetitive text). Single-stream
+        like SSE, so it borrows the stream slot cap instead of the
+        MicroBatcher; returns None when not eligible (sampling requested
+        or the engine lacks the method) so the caller falls back."""
+        if not hasattr(self.engine, "generate_speculative"):
+            return None
+        resolve = getattr(self.engine, "_resolve_gen_key", None)
+        if resolve is None:
+            return None
+        # Eligibility is judged on the RESOLVED params (config defaults
+        # fill omitted fields — a request without temperature usually
+        # samples): greedy, no repetition penalty.
+        key = resolve(
+            overrides.get("max_new_tokens"),
+            overrides.get("temperature"),
+            overrides.get("top_p"),
+            overrides.get("top_k"),
+            overrides.get("repetition_penalty"),
+        )
+        if key[1] > 0.0 or key[4] != 1.0:
+            return None
+        if not self._stream_slots.acquire(blocking=False):
+            # All slots busy: fall back to the batched path rather than
+            # erroring — the hint must never make a servable request fail.
+            return None
+        try:
+            tokens, stats = self.engine.generate_speculative(
+                prompt_ids,
+                max_new_tokens=overrides.get("max_new_tokens"),
+            )
+        finally:
+            self._stream_slots.release()
+        return self._reply_payload(
+            tokens, stats, reply_key, t0,
+            speculative={
+                "verify_calls": stats.get("verify_calls"),
+                "tokens_per_verify": stats.get("tokens_per_verify"),
+            },
+        )
 
     # -- streaming (SSE) ---------------------------------------------------
     def start_stream(self, path: str, body: Dict[str, Any],

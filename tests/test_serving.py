@@ -344,6 +344,75 @@ def test_stream_tail_flush_on_done_frame():
     assert done["delta"] != ""
 
 
+def test_speculative_request_path():
+    """{"speculative": true} on a greedy request runs the engine's
+    speculative path (stats surfaced); sampling requests silently fall
+    back to the batched path; engines without the method fall back."""
+
+    class SpecEngine(FakeEngine):
+        def __init__(self):
+            super().__init__()
+            self.spec_calls = 0
+
+        def _resolve_gen_key(self, mnt, temp, top_p, top_k, rep):
+            return (int(mnt or 8), float(0.0 if temp is None else temp),
+                    0, 1.0, 1.0)
+
+        def generate_speculative(self, prompt_tokens, max_new_tokens=None):
+            self.spec_calls += 1
+            toks = list(prompt_tokens)[:3]
+            return toks, {
+                "tokens_generated": len(toks), "stopped": "eos",
+                "verify_calls": 2, "tokens_per_verify": 1.5,
+            }
+
+    eng = SpecEngine()
+    srv = ChatServer(eng)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), srv.make_handler())
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        # Greedy + speculative: engine path used, stats in the reply.
+        code, body = _post(url, "/v1/generate",
+                           {"prompt": "hiya", "temperature": 0,
+                            "speculative": True})
+        assert code == 200 and eng.spec_calls == 1
+        assert body["speculative"]["verify_calls"] == 2
+        assert body["text"].startswith("tok:")
+        # Sampling + speculative: silently rides the batcher.
+        code, body = _post(url, "/v1/generate",
+                           {"prompt": "hiya", "temperature": 0.7,
+                            "speculative": True})
+        assert code == 200 and eng.spec_calls == 1
+        assert "speculative" not in body
+        # Stats counted both.
+        _, stats = _get(url, "/stats")
+        assert stats["requests"] == 2
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+    # Engine without the method: plain fallback, no error.
+    srv2 = ChatServer(FakeEngine())
+    code, body = srv2._run_model(
+        "/v1/generate", {"prompt": "hiya", "speculative": True}
+    )
+    assert code == 200 and "speculative" not in body
+
+    # Slots exhausted: falls back to the batched path, never 503s — the
+    # hint must not make a servable request fail.
+    eng3 = SpecEngine()
+    srv3 = ChatServer(eng3, max_streams=1)
+    assert srv3._stream_slots.acquire(blocking=False)  # hog the slot
+    code, body = srv3._run_model(
+        "/v1/generate",
+        {"prompt": "hiya", "temperature": 0, "speculative": True},
+    )
+    assert code == 200 and "speculative" not in body
+    assert eng3.spec_calls == 0
+
+
 def test_aborted_stream_still_counted():
     """Closing the event generator early (client disconnect) still books
     the streamed tokens into /stats."""
