@@ -361,6 +361,222 @@ def _child_main(name: str) -> None:
     print(json.dumps(result))
 
 
+def _pctl(xs, p):
+    """Percentile of a small sample (nearest-rank on the sorted list)."""
+    if not xs:
+        return None
+    xs = sorted(xs)
+    k = min(len(xs) - 1, max(0, int(round(p / 100.0 * (len(xs) - 1)))))
+    return xs[k]
+
+
+def _serve_run_continuous(sched, prompts, budgets):
+    """Drive the ContinuousScheduler with one thread per request via
+    submit_stream, timestamping every token for the latency histogram.
+    Returns (total_tokens, wall_s, inter_token_gaps_s, ttft_s)."""
+    import threading
+
+    results = [None] * len(prompts)
+
+    def worker(i):
+        t_s = time.perf_counter()
+        stamps = []
+        for item in sched.submit_stream(
+            prompts[i],
+            {
+                "max_new_tokens": budgets[i],
+                "temperature": 0.0,
+                "repetition_penalty": 1.0,
+            },
+        ):
+            if isinstance(item, dict):
+                break
+            stamps.append(time.perf_counter())
+        results[i] = (t_s, stamps)
+
+    threads = [
+        threading.Thread(target=worker, args=(i,))
+        for i in range(len(prompts))
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    tokens = sum(len(stamps) for _, stamps in results)
+    gaps, ttft = [], []
+    for t_s, stamps in results:
+        if stamps:
+            ttft.append(stamps[0] - t_s)
+        gaps += [b - a for a, b in zip(stamps, stamps[1:])]
+    return tokens, wall, gaps, ttft
+
+
+def _serve_run_legacy(batcher, prompts, budgets):
+    """Same workload through the run-to-completion MicroBatcher path.
+    Returns (total_tokens, wall_s)."""
+    import threading
+
+    results = [None] * len(prompts)
+
+    def worker(i):
+        results[i] = batcher.submit(
+            prompts[i],
+            {
+                "max_new_tokens": budgets[i],
+                "temperature": 0.0,
+                "repetition_penalty": 1.0,
+            },
+        )
+
+    threads = [
+        threading.Thread(target=worker, args=(i,))
+        for i in range(len(prompts))
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    tokens = sum(len(toks) for toks, _ in results)
+    return tokens, wall
+
+
+def _serve_bench_main(smoke: bool) -> None:
+    """Serving A/B: continuous batching (slot-paged pool, step-level
+    admission) vs the legacy MicroBatcher on a mixed-max_new workload —
+    the workload continuous batching exists for (the legacy path can't
+    even group mixed lengths into one batch: max_new is part of its
+    decode compile key, so the workload shatters into sequential
+    run-to-completion batches, while the continuous decode step treats
+    max_new as host state and serves everything on one executable).
+
+    Hermetic by contract: forces CPU, tiny random-weight model, stub
+    tokenizer, no files read. Prints exactly ONE JSON line; on any
+    failure the line carries an "error" field. --smoke-serve is the
+    scaled-down CI tier; --serve-bench runs the full 16-request
+    {8,64,256} acceptance workload.
+    """
+    result = {
+        "metric": "serve_tokens_per_sec_continuous",
+        "value": 0.0,
+        "unit": "tokens/sec",
+        "vs_baseline": 0.0,
+    }
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+        import numpy as np
+        from flax import linen as nn
+
+        from luminaai_tpu.config import Config
+        from luminaai_tpu.inference.generate import GenerationEngine
+        from luminaai_tpu.models.transformer import LuminaTransformer
+        from luminaai_tpu.serving.server import (
+            ContinuousScheduler,
+            MicroBatcher,
+        )
+
+        class _Tok:  # minimal engine contract; no tokenizer data needed
+            eos_token_id = 1
+            pad_token_id = 0
+            im_end = 2
+
+            class backend:
+                @staticmethod
+                def encode(text):
+                    return [3 + (ord(c) % 200) for c in text]
+
+            @staticmethod
+            def decode(tokens):
+                return " ".join(str(t) for t in tokens)
+
+        cfg = Config(
+            vocab_size=512,
+            hidden_size=64 if smoke else 128,
+            num_layers=2,
+            num_heads=4,
+            num_kv_heads=2,
+            seq_length=512,
+            use_flash_attention=False,
+            precision="fp32",
+            gradient_checkpointing=False,
+            max_new_tokens=32,
+        )
+        model = LuminaTransformer(cfg)
+        params = model.init(
+            jax.random.key(0), jnp.ones((1, 8), jnp.int32)
+        )["params"]
+        params = jax.tree.map(
+            lambda x: x.unbox() if isinstance(x, nn.meta.AxisMetadata) else x,
+            params,
+            is_leaf=lambda x: isinstance(x, nn.meta.AxisMetadata),
+        )
+        engine = GenerationEngine(model, params, _Tok(), cfg)
+
+        n_req = 8 if smoke else 16
+        budget_cycle = [4, 8, 24] if smoke else [8, 64, 256]
+        budgets = [budget_cycle[i % len(budget_cycle)] for i in range(n_req)]
+        rs = np.random.RandomState(0)
+        prompts = [
+            rs.randint(3, cfg.vocab_size, size=int(rs.randint(4, 24))).tolist()
+            for _ in range(n_req)
+        ]
+        num_slots = 4 if smoke else 8
+        sched = ContinuousScheduler(engine, num_slots=num_slots, page_size=64)
+        legacy = MicroBatcher(engine, max_batch=num_slots, window_ms=100.0)
+
+        # Warmup pass = compiles (both paths share the engine's caches
+        # where keys overlap); the measured pass is steady-state.
+        _serve_run_continuous(sched, prompts, budgets)
+        _serve_run_legacy(legacy, prompts, budgets)
+        c_tokens, c_wall, gaps, ttft = _serve_run_continuous(
+            sched, prompts, budgets
+        )
+        l_tokens, l_wall = _serve_run_legacy(legacy, prompts, budgets)
+
+        cont_tps = c_tokens / max(c_wall, 1e-9)
+        leg_tps = l_tokens / max(l_wall, 1e-9)
+        result.update(
+            value=round(cont_tps, 1),
+            # Baseline for THIS metric is the legacy micro-batched path
+            # on the same workload/hardware: >1.0 means continuous wins.
+            vs_baseline=round(cont_tps / max(leg_tps, 1e-9), 3),
+            extras={
+                "platform": jax.devices()[0].platform,
+                "mode": "smoke" if smoke else "full",
+                "requests": n_req,
+                "max_new_mix": budget_cycle,
+                "num_slots": num_slots,
+                "page_size": 64,
+                "tokens_continuous": c_tokens,
+                "tokens_legacy": l_tokens,
+                "legacy_tokens_per_sec": round(leg_tps, 1),
+                "speedup_vs_microbatch": round(
+                    cont_tps / max(leg_tps, 1e-9), 3
+                ),
+                "latency_ms_per_token": {
+                    "p50": round(1e3 * _pctl(gaps, 50), 2) if gaps else None,
+                    "p95": round(1e3 * _pctl(gaps, 95), 2) if gaps else None,
+                },
+                "ttft_ms": {
+                    "p50": round(1e3 * _pctl(ttft, 50), 2) if ttft else None,
+                    "p95": round(1e3 * _pctl(ttft, 95), 2) if ttft else None,
+                },
+                "decode_steps": int(sched.decoder.steps),
+                "slot_reuses": int(sched.decoder.pool.reuses),
+            },
+        )
+    except Exception as e:  # the artifact must stay parseable
+        result["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(result), flush=True)
+
+
 _HERE = os.path.dirname(os.path.abspath(__file__))
 LAST_GOOD_PATH = os.path.join(_HERE, "scripts", "last_good_bench.json")
 
@@ -691,5 +907,9 @@ def main() -> None:
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--child":
         _child_main(sys.argv[2])
+    elif "--smoke-serve" in sys.argv[1:]:
+        _serve_bench_main(smoke=True)
+    elif "--serve-bench" in sys.argv[1:]:
+        _serve_bench_main(smoke=False)
     else:
         main()

@@ -615,6 +615,12 @@ def cmd_serve(args) -> int:
         quantize=getattr(args, "quantize", None),
         adapter=getattr(args, "adapter", None),
         kv_cache_dtype=getattr(args, "kv_cache_dtype", None),
+        num_slots=getattr(args, "num_slots", 8),
+        page_size=getattr(args, "page_size", 128),
+        admission_window_ms=getattr(args, "admission_window_ms", 0.0),
+        continuous=(
+            False if getattr(args, "no_continuous", False) else "auto"
+        ),
     )
     return 0
 
@@ -1083,6 +1089,18 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--kv-cache-dtype", choices=["bf16", "int8"],
                     help="decode KV cache storage (int8 halves cache HBM)")
     sv.add_argument("--adapter", help="LoRA adapter merged at load")
+    sv.add_argument("--num-slots", dest="num_slots", type=int, default=8,
+                    help="continuous-batching KV pool slots "
+                         "(concurrent decode lanes)")
+    sv.add_argument("--page-size", dest="page_size", type=int, default=128,
+                    help="KV pool page granularity in tokens")
+    sv.add_argument("--admission-window-ms", dest="admission_window_ms",
+                    type=float, default=0.0,
+                    help="wait this long for same-key peers before a "
+                         "generation's first decode step")
+    sv.add_argument("--no-continuous", dest="no_continuous",
+                    action="store_true",
+                    help="legacy run-to-completion micro-batching")
     sv.set_defaults(fn=cmd_serve)
 
     b = sub.add_parser("benchmark", help="run the bench harness")

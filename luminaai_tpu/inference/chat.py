@@ -116,7 +116,11 @@ def load_model_for_inference(
         sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
         meta_tree = ocp.PyTreeCheckpointer().metadata(
             str(ckpt / str(step) / "state")
-        ).item_metadata
+        )
+        # Orbax API drift: metadata() returns the tree directly on newer
+        # versions, an object carrying .item_metadata (sometimes with a
+        # further .tree) on older ones.
+        meta_tree = getattr(meta_tree, "item_metadata", meta_tree)
         meta_tree = getattr(meta_tree, "tree", meta_tree)
         abstract = jax.tree.map(
             lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype, sharding=sharding),

@@ -351,9 +351,23 @@ class GenerationEngine:
             ),
         )
 
-    def _trim_prompt(self, prompt, max_new: int) -> List[int]:
-        """Keep the prompt tail that fits the context budget (ref :374)."""
-        max_prompt = self.max_context - max_new - 1
+    def _trim_prompt(
+        self, prompt, max_new: int, capacity: Optional[int] = None
+    ) -> List[int]:
+        """Keep the prompt tail that fits the context budget (ref :374).
+
+        capacity defaults to the engine's max_context; the step-wise
+        decoder passes its slot budget so both paths share ONE formula
+        (and stay token-identical for over-length prompts).
+
+        Clamped to >= 1: an oversized max_new (the server caps it, but
+        its cap can exceed a small engine's max_context) would make the
+        budget non-positive, and p[-max_prompt:] with a POSITIVE index
+        then keeps an over-budget prompt that crashes prefill — serve the
+        last token and let the length budget truncate instead (ADVICE r5
+        low)."""
+        cap = self.max_context if capacity is None else capacity
+        max_prompt = max(1, cap - max_new - 1)
         p = list(prompt)
         return p[-max_prompt:] if len(p) > max_prompt else p
 
@@ -415,9 +429,12 @@ class GenerationEngine:
             # of slack or later rows evict earlier rows' in-band keys
             # (enforced at trace time in the attention layer). Cap the
             # draft; with zero slack (window % 128 == 0) fall back to
-            # plain greedy decode.
+            # plain greedy decode. The layer rolls whenever C_cache <
+            # seq_length — NOT < max_context — so mirror exactly that
+            # condition or a small-max_context engine 500s at trace time
+            # instead of falling back (ADVICE r5 medium).
             slots = min(self.max_context, ((w + 127) // 128) * 128)
-            if slots < self.max_context:  # rolling actually engages
+            if slots < self.config.seq_length:  # rolling actually engages
                 k = min(k, slots - w + 1)
                 if k < 2:
                     return self.generate(
@@ -785,6 +802,335 @@ class GenerationEngine:
         """Encode a conversation, generate, decode assistant text."""
         tokens, stats = self.generate(self.encode_chat(messages), **kw)
         return self.tokenizer.decode(tokens), stats
+
+    # -- continuous batching (step-wise decode over a slot-paged pool) -----
+    def make_stepwise(
+        self,
+        num_slots: int = 8,
+        page_size: int = 128,
+        max_slot_tokens: Optional[int] = None,
+    ) -> "StepwiseDecoder":
+        """Build a StepwiseDecoder: the scheduler-owned decode API
+        (prefill_into_slot + decode_step) continuous batching runs on.
+        The single-sequence generate()/generate_batch() paths above are
+        untouched — this is an additional serving surface, not a
+        replacement."""
+        return StepwiseDecoder(
+            self,
+            num_slots=num_slots,
+            page_size=page_size,
+            max_slot_tokens=max_slot_tokens,
+        )
+
+
+GREEDY_SAMPLE_KEY = (0.0, 0, 1.0, 1.0)  # (temperature, top_k, top_p, rep)
+
+
+class StepwiseDecoder:
+    """Step-wise decode over a slot-paged KV pool (continuous batching).
+
+    The run-to-completion paths (generate / generate_batch) trace the
+    whole decode into one lax.while_loop, so a batch admits requests only
+    at its start and every early-finishing lane rides along as a frozen
+    row until the slowest request completes. Here the HOST owns the loop:
+
+      prefill_into_slot(slot, prompt, ...) writes a request's prompt KV
+        into its pool slot (one jit call, bucketed like generate's
+        prefill) and samples its first token;
+      decode_step(sample_key) advances ALL active lanes one token in one
+        jit call and reports per-lane (token, produced, eos) — the
+        scheduler evicts finished slots and admits queued requests into
+        the freed lanes BETWEEN steps.
+
+    Greedy step-wise decode is token-identical to generate() (same
+    prefill bucketing, same sampling math, same rng split discipline —
+    parity-tested), and sampled decode is bit-identical for the same
+    per-request seed. The pool is plain-layout (never rolling): admission
+    bounds prompt+max_new to the slot capacity, so positions never wrap,
+    and attention_window configs are served by the per-lane band mask.
+
+    One decode-step compile per sampling parameter set (max_new is host
+    state now, NOT part of the compile key — mixed-length workloads share
+    one executable, the core of the continuous-batching win).
+    """
+
+    def __init__(
+        self,
+        engine: GenerationEngine,
+        num_slots: int = 8,
+        page_size: int = 128,
+        max_slot_tokens: Optional[int] = None,
+    ):
+        from luminaai_tpu.inference.kv_pool import PagedKVPool, to_paged
+
+        self.engine = engine
+        self.model = engine.model
+        self.params = engine.params
+        cap = int(max_slot_tokens or engine.max_context)
+        page_size = max(1, int(page_size))
+        pages = max(1, -(-cap // page_size))
+        num_slots = max(1, int(num_slots))
+        caches = engine.model.init_cache(
+            num_slots,
+            pages * page_size,
+            kv_cache_dtype=getattr(engine.config, "kv_cache_dtype", None),
+            rolling=False,
+        )
+        self.pool = PagedKVPool(
+            to_paged(caches, pages, page_size),
+            num_slots=num_slots,
+            pages=pages,
+            page_size=page_size,
+        )
+        self.num_slots = num_slots
+        self.slot_tokens = pages * page_size
+        # The decode budget honors the ENGINE's context contract: the
+        # page rounding above may leave slack rows past max_context, and
+        # decoding into them would silently run the model at
+        # out-of-contract positions. Trim/clamp arithmetic below uses
+        # this, with exactly generate()'s _trim_prompt formula, so the
+        # two paths serve identical tokens for over-length prompts too.
+        self.token_capacity = min(self.slot_tokens, engine.max_context)
+        # Host-side lane state; device state is the pool + counts + rngs.
+        self._tokens = np.zeros((num_slots,), np.int32)
+        self._pos = np.zeros((num_slots,), np.int32)
+        self._active = np.zeros((num_slots,), bool)
+        self._counts = jnp.zeros(
+            (num_slots, engine.config.vocab_size), jnp.int32
+        )
+        self._rngs = jax.random.split(jax.random.PRNGKey(0), num_slots)
+        self.steps = 0
+        self._fns: Dict[Any, Any] = {}
+
+    # -- slot lifecycle ----------------------------------------------------
+    def has_free_slot(self) -> bool:
+        return self.pool.has_free()
+
+    def acquire_slot(self) -> int:
+        return self.pool.alloc()
+
+    def release_slot(self, slot: int) -> None:
+        self._active[slot] = False
+        self.pool.free(slot)
+
+    def active_count(self) -> int:
+        return int(self._active.sum())
+
+    def lane_full(self, slot: int) -> bool:
+        """Next decode row would overflow the slot's token budget."""
+        return int(self._pos[slot]) >= self.token_capacity
+
+    # -- jitted pieces -----------------------------------------------------
+    def _flat(self, tree):
+        from luminaai_tpu.inference.kv_pool import to_flat
+
+        return to_flat(tree, self.pool.pages, self.pool.page_size)
+
+    def _paged(self, tree):
+        from luminaai_tpu.inference.kv_pool import to_paged
+
+        return to_paged(tree, self.pool.pages, self.pool.page_size)
+
+    def _get_prefill(self, bucket: int):
+        key = ("prefill", bucket)
+        if key not in self._fns:
+            engine = self.engine
+            # Page-aligned prefix, not the whole slot: the insert below
+            # then moves O(prompt) rows per admission instead of
+            # O(slot_tokens). Rows past the prefix keep the previous
+            # occupant's stale K/V — safe, because every row is written
+            # by its occupant before the per-lane mask first admits it.
+            ps = self.pool.page_size
+            capacity = min(-(-bucket // ps) * ps, self.slot_tokens)
+
+            def prefill(params, ids, length):
+                caches = engine.model.init_cache(
+                    1,
+                    capacity,
+                    kv_cache_dtype=getattr(
+                        engine.config, "kv_cache_dtype", None
+                    ),
+                    rolling=False,
+                )
+                pos = jnp.arange(bucket)
+                positions = jnp.where(pos < length, pos, -1)[None, :]
+                logits, caches, _ = engine.model.apply(
+                    {"params": params},
+                    ids,
+                    positions=positions,
+                    kv_caches=caches,
+                    # [1]-shaped index selects the PER-LANE cache path:
+                    # plain absolute rows even under attention_window
+                    # (the pool never rolls).
+                    cache_index=jnp.zeros((1,), jnp.int32),
+                    deterministic=True,
+                )
+                last = jnp.take_along_axis(
+                    logits, (length - 1)[None, None, None], axis=1
+                )[:, 0, :]
+                return last, caches
+
+            self._fns[key] = jax.jit(prefill)
+        return self._fns[key]
+
+    def _get_insert(self):
+        if "insert" not in self._fns:
+
+            page_size = self.pool.page_size
+
+            def insert(pool_caches, fresh, slot):
+                def put(p, f):
+                    # Page the fresh rows (a page-aligned PREFIX of the
+                    # slot, not necessarily all of it), then land them at
+                    # the slot axis — ndim-5 in paged layout, so the rule
+                    # also covers scan_layers' extra leading segment axis.
+                    fp = f.reshape(
+                        f.shape[:-3]
+                        + (f.shape[-3] // page_size, page_size)
+                        + f.shape[-2:]
+                    )
+                    starts = [0] * p.ndim
+                    starts[p.ndim - 5] = slot
+                    return jax.lax.dynamic_update_slice(p, fp, tuple(starts))
+
+                return jax.tree.map(put, pool_caches, fresh)
+
+            self._fns["insert"] = jax.jit(insert)
+        return self._fns["insert"]
+
+    def _get_step(self, sample_key):
+        key = ("step", sample_key)
+        if key not in self._fns:
+            temperature, top_k, top_p, rep_penalty = sample_key
+            stop_ids = jnp.asarray(
+                sorted(self.engine._stop_set), dtype=jnp.int32
+            )
+            S = self.num_slots
+
+            def step(params, caches, tokens, pos, active, counts, rngs):
+                flat = self._flat(caches)
+                split2 = jax.vmap(lambda r: jax.random.split(r, 2))(rngs)
+                new_rngs, step_rngs = split2[:, 0], split2[:, 1]
+                logits, flat, _ = self.model.apply(
+                    {"params": params},
+                    tokens[:, None],
+                    positions=pos[:, None],
+                    kv_caches=flat,
+                    cache_index=pos,  # [S]: per-lane offsets
+                    deterministic=True,
+                )
+                nxt = jax.vmap(
+                    lambda r, l, c: sample_token(
+                        r, l, c,
+                        temperature=temperature, top_k=top_k, top_p=top_p,
+                        repetition_penalty=rep_penalty,
+                    )
+                )(step_rngs, logits[:, -1], counts).astype(jnp.int32)
+                nxt = jnp.where(active, nxt, tokens)
+                counts = counts.at[jnp.arange(S), nxt].add(
+                    active.astype(counts.dtype)
+                )
+                eos = jnp.logical_and(
+                    active,
+                    jnp.any(nxt[:, None] == stop_ids[None, :], axis=1),
+                )
+                return self._paged(flat), nxt, eos, counts, new_rngs
+
+            self._fns[key] = jax.jit(step)
+        return self._fns[key]
+
+    # -- scheduler-facing API ----------------------------------------------
+    def prefill_into_slot(
+        self,
+        slot: int,
+        prompt_tokens: Sequence[int],
+        max_new_tokens: int = 1,
+        sample_key: Optional[Tuple] = None,
+        seed: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """Write a request's prompt KV into pool slot `slot` and sample
+        its first token. Returns {"token": int | None, "prompt_tokens",
+        "is_stop"}; the lane is activated unless the first token already
+        stopped (or the budget is a single token)."""
+        sample_key = sample_key or GREEDY_SAMPLE_KEY
+        max_new = max(1, int(max_new_tokens))
+        if not list(prompt_tokens):
+            raise ValueError("prefill_into_slot needs a non-empty prompt")
+        # generate()'s own trim against the slot's budget — one shared
+        # formula, so the two paths stay token-identical even for
+        # over-length prompts.
+        prompt = self.engine._trim_prompt(
+            prompt_tokens, max_new, capacity=self.token_capacity
+        )
+        L = len(prompt)
+        bucket = min(_bucket_len(L), self.slot_tokens)
+        ids = np.zeros((1, bucket), dtype=np.int32)
+        ids[0, :L] = prompt
+        logits, fresh = self._get_prefill(bucket)(
+            self.params, jnp.asarray(ids), jnp.asarray(L, jnp.int32)
+        )
+        rng = jax.random.PRNGKey(
+            seed if seed is not None else (time.time_ns() & 0xFFFFFFFF)
+        )
+        rng, first_rng = jax.random.split(rng)
+        first = int(
+            sample_token(
+                first_rng,
+                logits[0],
+                jnp.zeros((logits.shape[-1],), jnp.int32),
+                temperature=sample_key[0], top_k=sample_key[1],
+                top_p=sample_key[2], repetition_penalty=sample_key[3],
+            )
+        )
+        is_stop = first in self.engine._stop_set
+        self.pool.caches = self._get_insert()(
+            self.pool.caches, fresh, jnp.asarray(slot, jnp.int32)
+        )
+        self.pool.lengths[slot] = L
+        self._tokens[slot] = first
+        self._pos[slot] = L
+        self._active[slot] = (not is_stop) and max_new > 1
+        self._counts = self._counts.at[slot].set(0)
+        if not is_stop:
+            self._counts = self._counts.at[slot, first].add(1)
+        self._rngs = self._rngs.at[slot].set(rng)
+        return {
+            "token": None if is_stop else first,
+            "prompt_tokens": L,
+            "is_stop": is_stop,
+        }
+
+    def decode_step(
+        self, sample_key: Optional[Tuple] = None
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Advance every active lane one token (one jit call). Returns
+        (tokens[S], produced[S], eos[S]): `produced` lanes emitted
+        tokens[slot] this step; `eos` lanes hit a stop token (dropped,
+        matching generate()) and were deactivated — the scheduler frees
+        their slots."""
+        was_active = self._active.copy()
+        fn = self._get_step(sample_key or GREEDY_SAMPLE_KEY)
+        caches, nxt, eos, counts, rngs = fn(
+            self.params,
+            self.pool.caches,
+            jnp.asarray(self._tokens),
+            jnp.asarray(self._pos),
+            jnp.asarray(self._active),
+            self._counts,
+            self._rngs,
+        )
+        self.pool.caches = caches
+        self._counts = counts
+        self._rngs = rngs
+        nxt_h = np.asarray(nxt)
+        eos_h = np.asarray(eos)
+        self._tokens = nxt_h.copy()
+        self._pos[was_active] += 1
+        self.pool.lengths[was_active] += 1
+        self._active &= ~eos_h
+        self.steps += 1
+        produced = was_active & ~eos_h
+        return nxt_h, produced, eos_h
 
 
 def _per_layer_view(params: Dict[str, Any]) -> Tuple[Dict[str, Any], bool]:

@@ -1,0 +1,143 @@
+"""Slot-paged KV cache pool for continuous (in-flight) batching.
+
+The serving decode path keeps ONE preallocated KV pool shaped
+`[num_slots, pages, page_size, kv_heads, head_dim]` per layer (per k/v;
+int8 caches carry a (codes, scales) pair per side) instead of allocating
+a fresh cache per batch. Requests are admitted into *slots* — the unit
+the host-side free-list hands out — and a slot's KV region is tiled into
+`pages` of `page_size` tokens, the TPU-friendly granularity the ragged
+paged-attention literature standardizes on (arxiv 2604.15464): page-
+aligned rows keep cache writes on (8,128)-tiled boundaries and leave the
+door open to page-level sharing/compaction without relayout.
+
+Device arrays live here only as an opaque pytree (`self.caches`); all
+accounting — the free-list, per-slot length vector, reuse counters — is
+host-side numpy, so the scheduler never has to read device memory to
+make an admission decision. The pool is deliberately dumb: it allocates
+and frees slots and REFUSES to double-allocate; which request occupies a
+slot, and when it is evicted, is the ContinuousScheduler's business
+(serving/server.py), and how rows are written per-lane is the attention
+layer's (models/layers.py per-lane cache update).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+
+def to_paged(tree, pages: int, page_size: int):
+    """Reshape a model-layout cache tree into the paged pool layout:
+    [..., C, heads, dim] leaves become [..., pages, page_size, heads,
+    dim]. The row axis is addressed from the TAIL (ndim-3) so the rule
+    covers both the plain per-layer layout ([slots, C, ...]) and the
+    scan_layers layout with its extra leading segment axis ([count,
+    slots, C, ...]). Pure metadata under jit (C == pages * page_size is
+    contiguous)."""
+    import jax
+
+    return jax.tree.map(
+        lambda x: x.reshape(
+            x.shape[:-3] + (pages, page_size) + x.shape[-2:]
+        ),
+        tree,
+    )
+
+
+def to_flat(tree, pages: int, page_size: int):
+    """Inverse of to_paged: the [..., pages*page_size, heads, dim] view
+    the model's attention layers consume."""
+    import jax
+
+    return jax.tree.map(
+        lambda x: x.reshape(
+            x.shape[:-4] + (pages * page_size,) + x.shape[-2:]
+        ),
+        tree,
+    )
+
+
+class PagedKVPool:
+    """Host-side slot accounting over a preallocated paged KV cache tree.
+
+    caches: the device pytree in paged layout (or None for accounting-only
+    use in tests/fakes). alloc()/free() manage the slot free-list; lengths
+    tracks rows in use per slot (the attention mask budget); reuses counts
+    how many times a previously-occupied slot was handed out again — the
+    continuous-batching win condition.
+    """
+
+    def __init__(
+        self,
+        caches: Optional[Any],
+        num_slots: int,
+        pages: int,
+        page_size: int,
+    ):
+        if num_slots < 1 or pages < 1 or page_size < 1:
+            raise ValueError(
+                f"pool needs >=1 slot/page/row, got "
+                f"{num_slots}/{pages}/{page_size}"
+            )
+        self.caches = caches
+        self.num_slots = int(num_slots)
+        self.pages = int(pages)
+        self.page_size = int(page_size)
+        self.lengths = np.zeros((num_slots,), np.int64)
+        # LIFO free-list: the most recently freed slot is re-issued first,
+        # so its cache rows are the warmest in HBM when overwritten.
+        self._free: List[int] = list(range(num_slots - 1, -1, -1))
+        self._allocated: set = set()
+        self.reuses = 0
+        self.slot_uses = np.zeros((num_slots,), np.int64)
+
+    @property
+    def slot_tokens(self) -> int:
+        """Token capacity of one slot (pages * page_size rows)."""
+        return self.pages * self.page_size
+
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def has_free(self) -> bool:
+        return bool(self._free)
+
+    def alloc(self) -> int:
+        """Hand out a free slot. Raises when exhausted; a slot can never
+        be live twice (the double-allocation class of bug that silently
+        interleaves two requests' KV rows)."""
+        if not self._free:
+            raise RuntimeError("KV pool exhausted: no free slots")
+        slot = self._free.pop()
+        if slot in self._allocated:  # pragma: no cover - invariant guard
+            raise RuntimeError(f"slot {slot} double-allocated")
+        self._allocated.add(slot)
+        if self.slot_uses[slot] > 0:
+            self.reuses += 1
+        self.slot_uses[slot] += 1
+        return slot
+
+    def free(self, slot: int) -> None:
+        """Return a slot to the free-list. Stale rows are NOT zeroed —
+        every consumer masks by length, and the next prefill overwrites
+        the rows it needs."""
+        if slot not in self._allocated:
+            raise ValueError(f"slot {slot} is not allocated")
+        self._allocated.remove(slot)
+        self.lengths[slot] = 0
+        self._free.append(slot)
+
+    def allocated_slots(self) -> List[int]:
+        return sorted(self._allocated)
+
+    def stats(self) -> dict:
+        return {
+            "num_slots": self.num_slots,
+            "pages": self.pages,
+            "page_size": self.page_size,
+            "slot_tokens": self.slot_tokens,
+            "in_use": len(self._allocated),
+            "free": len(self._free),
+            "reuses": self.reuses,
+        }

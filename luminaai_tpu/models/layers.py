@@ -307,18 +307,55 @@ class GQAttention(nn.Module):
         if kv_cache is not None:
             ck, cv = kv_cache
             C_cache = (ck[0] if isinstance(ck, tuple) else ck).shape[1]
+            # A cache_index of shape [B] means PER-LANE offsets: each
+            # batch row is an independent slot of a paged pool at its own
+            # sequence position (continuous batching — the scheduler owns
+            # the decode loop and lanes join/leave mid-flight). Writes
+            # scatter at per-lane rows; attention masks per lane. The pool
+            # is admission-bounded (never wraps), so per-lane mode is
+            # always plain-layout even under attention_window.
+            per_lane = (
+                cache_index is not None
+                and getattr(cache_index, "ndim", 0) == 1
+            )
             windowed = cfg.attention_window is not None
             # The cache is ROLLING only when init_cache actually shrank it
             # below the position span (see init_cache); otherwise slot ==
             # position and every plain-layout path below applies.
-            rolling = windowed and C_cache < max(cfg.seq_length, S)
+            rolling = (
+                windowed
+                and C_cache < max(cfg.seq_length, S)
+                and not per_lane
+            )
             # Rolling-cache write index: slot = pos % C; decode wraps.
             if rolling and S == 1:
                 write_at = jnp.mod(cache_index, C_cache)
             else:
                 write_at = cache_index
 
-            if rolling and S > 1:
+            if per_lane and S > 1:
+                # Per-lane multi-row write (prefill-into-slot): rows land
+                # at their ABSOLUTE positions — no wrap, the pool slot is
+                # sized to the request's full token budget. Liveness from
+                # the caller's positions as in the rolling scatter below:
+                # -1-marked bucket padding drops into the dummy row C so
+                # it can never clobber a live slot.
+                if positions is None:
+                    raise ValueError(
+                        "per-lane multi-row cache writes need explicit "
+                        "positions (padding rows marked -1)"
+                    )
+                idx = jnp.where(positions >= 0, positions, C_cache)
+                rows = jnp.arange(B)[:, None]
+
+                def _scatter(cache_arr, fresh):
+                    buf = jnp.pad(
+                        cache_arr,
+                        ((0, 0), (0, 1)) + ((0, 0),) * (cache_arr.ndim - 2),
+                    )
+                    return buf.at[rows, idx].set(fresh)[:, :C_cache]
+
+            elif rolling and S > 1:
                 # Multi-row write into a rolling cache: LIVE rows land at
                 # pos % C with last-C-wins over live positions. Liveness
                 # comes from the caller's positions: the engine marks
@@ -368,9 +405,13 @@ class GQAttention(nn.Module):
                 def _upd(cache, fresh):
                     codes, scales = cache
                     q8, s = quantize_act(fresh)
-                    if rolling and S > 1:
+                    if S > 1 and (rolling or per_lane):
                         codes = _scatter(codes, q8)
                         scales = _scatter(scales, s)
+                    elif per_lane:
+                        lanes = jnp.arange(B)
+                        codes = codes.at[lanes, write_at].set(q8[:, 0])
+                        scales = scales.at[lanes, write_at].set(s[:, 0])
                     else:
                         codes = jax.lax.dynamic_update_slice(
                             codes, q8, (0, write_at, 0, 0)
@@ -386,8 +427,13 @@ class GQAttention(nn.Module):
                 ck, k_att = _upd(ck, k)
                 cv, v_att = _upd(cv, v)
             else:
-                if rolling and S > 1:
+                if S > 1 and (rolling or per_lane):
                     ck, cv = _scatter(ck, k), _scatter(cv, v)
+                elif per_lane:
+                    # One decode row per lane, each at its own offset.
+                    lanes = jnp.arange(B)
+                    ck = ck.at[lanes, write_at].set(k[:, 0])
+                    cv = cv.at[lanes, write_at].set(v[:, 0])
                 else:
                     ck = jax.lax.dynamic_update_slice(
                         ck, k, (0, write_at, 0, 0)
@@ -545,11 +591,31 @@ class GQAttention(nn.Module):
         scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
         logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k).astype(jnp.float32) * scale
 
+        w = self.config.attention_window
+        if (
+            decoding
+            and cache_index is not None
+            and getattr(cache_index, "ndim", 0) == 1
+        ):
+            # PER-LANE decode (continuous batching): every lane sits at
+            # its own offset in its own pool slot, so the causal/window
+            # mask is batched. The pool never wraps (admission keeps
+            # positions < C), so plain slot == position arithmetic holds
+            # even when the window is set.
+            qp = cache_index[:, None, None] + jnp.arange(Sq)[None, :, None]
+            kp = jnp.arange(Skv)[None, None, :]
+            mask = kp <= qp
+            if w is not None:
+                mask = jnp.logical_and(mask, qp - kp < w)
+            logits = jnp.where(mask[:, None, None], logits, -1e30)
+            probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+            out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)
+            return out.reshape(B, Sq, n_q, d)
+
         q_pos = jnp.arange(Sq)[:, None]
         if decoding:
             q_pos = q_pos + cache_index
         k_pos = jnp.arange(Skv)[None, :]
-        w = self.config.attention_window
         if (
             decoding
             and w is not None

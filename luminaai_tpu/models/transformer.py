@@ -430,7 +430,11 @@ class LuminaTransformer(nn.Module):
 
     # -- decode cache (ref Chat.py:346 GenerationEngine cache handling) ----
     def init_cache(
-        self, batch_size: int, max_len: int, kv_cache_dtype: str = None
+        self,
+        batch_size: int,
+        max_len: int,
+        kv_cache_dtype: str = None,
+        rolling: bool = True,
     ):
         """Preallocated KV caches, shaped to match the layer-stack layout:
         per-layer pairs normally; per-segment stacked pairs under
@@ -449,12 +453,21 @@ class LuminaTransformer(nn.Module):
         plain layout when the cache never wraps, so this is purely an
         allocation decision. Skipped when max_len exceeds the config
         sequence length (the RoPE table is sized by config.seq_length
-        once the cache no longer records absolute positions)."""
+        once the cache no longer records absolute positions).
+
+        rolling=False forces the plain position-addressed layout even
+        under attention_window — the slot-paged continuous-batching pool
+        (inference/kv_pool.py) is admission-bounded so positions never
+        wrap, and its per-lane writes assume slot == position."""
         cfg = self.config
         choice = kv_cache_dtype or cfg.kv_cache_dtype
         d = cfg.head_dim()
         C = max_len
-        if cfg.attention_window is not None and max_len <= cfg.seq_length:
+        if (
+            rolling
+            and cfg.attention_window is not None
+            and max_len <= cfg.seq_length
+        ):
             C = min(max_len, ((cfg.attention_window + 127) // 128) * 128)
         shape = (batch_size, C, cfg.num_kv_heads, d)
 

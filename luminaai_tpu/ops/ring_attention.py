@@ -299,11 +299,15 @@ def ring_attention(
     """
     from luminaai_tpu.ops.flash_attention import flash_eligible
 
-    if window is not None and use_flash and not causal:
+    if window is not None and not causal:
+        # Both paths, one contract (ADVICE r5 low): the Pallas banded
+        # grids assume causality, and the einsum chunk mask only bounds
+        # diff < window — non-causal it would silently attend unbounded
+        # FUTURE positions (a one-sided band nobody asked for).
         raise ValueError(
-            "windowed ring attention is causal-only on the flash path "
-            "(the Pallas banded grids assume causality); use "
-            "use_flash=False for a non-causal window"
+            "windowed ring attention is causal-only: a non-causal window "
+            "would need a symmetric |q_pos - k_pos| < window band neither "
+            "path implements; drop the window or use causal=True"
         )
     axis_size = mesh.shape[axis_name]
     if q_spec is None:

@@ -1,3 +1,8 @@
-from luminaai_tpu.serving.server import ChatServer, serve
+from luminaai_tpu.serving.server import (
+    ChatServer,
+    ContinuousScheduler,
+    MicroBatcher,
+    serve,
+)
 
-__all__ = ["ChatServer", "serve"]
+__all__ = ["ChatServer", "ContinuousScheduler", "MicroBatcher", "serve"]

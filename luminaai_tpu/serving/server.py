@@ -4,11 +4,16 @@ The reference deploys its chat model behind an HTTP backend
 (ref: Dockerfile.backend — Flask server on :5001 with a /health check,
 docker-compose.dev.yml wiring; the Electron desktop app in package.json
 talks to it). This is that surface, TPU-side: a ThreadingHTTPServer wrapping
-GenerationEngine. Concurrent requests with identical sampling parameters
-are grouped by a MicroBatcher worker into ONE batched decode
-(engine.generate_batch) — one chip step advances every in-flight stream —
-with the security stack (auth, rate limiting, input validation) optional
-on the same endpoints.
+GenerationEngine. Generation requests ride CONTINUOUS BATCHING: a
+ContinuousScheduler owns a step-wise decode loop over a slot-paged KV
+pool (engine.make_stepwise), admitting queued requests into slots freed
+by finished ones at every token step — no lane ever idles behind a
+slower request, and mixed max_new_tokens workloads share one decode
+executable. Engines without the step-wise API (and continuous=False)
+fall back to the legacy MicroBatcher, which groups same-parameter
+requests into run-to-completion generate_batch calls. The security stack
+(auth, rate limiting, input validation) is optional on the same
+endpoints either way.
 
 Endpoints:
   GET  /health            liveness + model info (ref HEALTHCHECK contract)
@@ -127,6 +132,321 @@ class MicroBatcher:
                     item[3].set()
 
 
+class _ContinuousRequest:
+    """One in-flight request inside the ContinuousScheduler: its prompt,
+    resolved budgets, and the sink its tokens stream into (a Queue for
+    SSE streams, an Event + result for blocking submits)."""
+
+    def __init__(self, prompt, max_new, sample_key, seed, stream):
+        self.prompt = list(prompt)
+        self.max_new = int(max_new)
+        self.sample_key = sample_key
+        self.seed = seed
+        self.stream = bool(stream)
+        self.sink: "queue.Queue" = queue.Queue() if stream else None
+        self.event = None if stream else threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self.tokens: List[int] = []
+        self.cancelled = False
+        self.done = False
+        self.slot: Optional[int] = None
+        self.prompt_tokens = 0
+        self.admitted_step: Optional[int] = None
+        self.t0 = time.time()
+
+
+class ContinuousScheduler:
+    """Continuous (in-flight) batching over a slot-paged KV pool.
+
+    Replaces the MicroBatcher's run-to-completion batches for engines
+    exposing the step-wise decode API (GenerationEngine.make_stepwise):
+    a single worker owns the decode loop, and EVERY step it (1) frees the
+    slots of finished lanes, (2) admits queued requests into freed slots
+    (prefill-then-join), and (3) advances all active lanes one token in
+    one jit call. Early finishers stop costing chip steps the moment they
+    stop, p50 latency decouples from the slowest request in flight, and —
+    because max_new is host state, not a compile key — mixed-length
+    workloads share one decode executable instead of splitting into
+    per-length micro-batches.
+
+    Sampling parameters DO remain a compile key (the sampling math traces
+    them), so one "generation" admits only requests with an identical
+    resolved sampling key; a mismatched request parks in `_pending`, new
+    admissions pause, the active lanes drain, and the scheduler switches
+    keys — bounded-latency FIFO across keys rather than starvation.
+
+    Tokens stream out per-slot as they decode: `submit()` blocks like the
+    MicroBatcher, `submit_stream()` returns a generator with the engine
+    generate_stream contract (ints, then a stats dict) that the existing
+    SSE path consumes unchanged; closing it cancels the lane at the next
+    step, so a gone client stops costing decode immediately.
+    """
+
+    def __init__(
+        self,
+        engine,
+        num_slots: int = 8,
+        page_size: int = 128,
+        admission_window_ms: float = 0.0,
+        max_slot_tokens: Optional[int] = None,
+        decoder=None,
+    ):
+        self.engine = engine
+        self.decoder = decoder or engine.make_stepwise(
+            num_slots=num_slots,
+            page_size=page_size,
+            max_slot_tokens=max_slot_tokens,
+        )
+        self.q: "queue.Queue" = queue.Queue()
+        self.window = max(0.0, float(admission_window_ms)) / 1000.0
+        # Stat names shared with MicroBatcher so /stats stays stable:
+        # batches = generations (one sampling key each), max_batch_seen =
+        # peak concurrent lanes.
+        self.batches = 0
+        self.max_batch_seen = 0
+        self.requests_served = 0
+        self._pending: List[_ContinuousRequest] = []
+        self._worker = threading.Thread(target=self._loop, daemon=True)
+        self._worker.start()
+
+    # -- public API --------------------------------------------------------
+    def submit(
+        self, prompt_tokens: List[int], gen_kwargs: Dict[str, Any]
+    ) -> Tuple[List[int], Dict[str, Any]]:
+        req = self._make_request(prompt_tokens, gen_kwargs, stream=False)
+        self.q.put(req)
+        req.event.wait()
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    def submit_stream(
+        self, prompt_tokens: List[int], gen_kwargs: Dict[str, Any]
+    ):
+        """Generator with the generate_stream contract: token ints as the
+        lane decodes them, then one final stats dict. Closing it flags the
+        request cancelled; the worker frees the slot at the next step."""
+        req = self._make_request(prompt_tokens, gen_kwargs, stream=True)
+        self.q.put(req)
+
+        def events():
+            try:
+                while True:
+                    item = req.sink.get()
+                    if isinstance(item, BaseException):
+                        raise item
+                    yield item
+                    if isinstance(item, dict):
+                        return
+            finally:
+                req.cancelled = True
+
+        return events()
+
+    def stats(self) -> Dict[str, Any]:
+        out = {
+            "scheduler": "continuous",
+            "batches": self.batches,
+            "max_batch_seen": self.max_batch_seen,
+            "decode_steps": int(getattr(self.decoder, "steps", 0)),
+        }
+        pool = getattr(self.decoder, "pool", None)
+        if pool is not None and hasattr(pool, "stats"):
+            out["kv_pool"] = pool.stats()
+        return out
+
+    # -- internals ---------------------------------------------------------
+    def _make_request(self, prompt_tokens, gen_kwargs, stream):
+        resolve = getattr(self.engine, "_resolve_gen_key", None)
+        if resolve is not None:
+            key = resolve(
+                gen_kwargs.get("max_new_tokens"),
+                gen_kwargs.get("temperature"),
+                gen_kwargs.get("top_p"),
+                gen_kwargs.get("top_k"),
+                gen_kwargs.get("repetition_penalty"),
+            )
+            max_new, sample_key = key[0], tuple(key[1:])
+        else:  # duck-typed engines without the helper
+            max_new = int(gen_kwargs.get("max_new_tokens") or 16)
+            sample_key = tuple(
+                sorted(
+                    (k, v)
+                    for k, v in gen_kwargs.items()
+                    if k not in ("max_new_tokens", "seed")
+                )
+            )
+        cap = int(
+            getattr(self.decoder, "token_capacity", 0)
+            or getattr(self.decoder, "slot_tokens", 0)
+        ) or None
+        if cap:
+            # A slot must hold prompt tail + budget; the prompt trims but
+            # the budget can only clamp. token_capacity (not the page-
+            # rounded slot size) keeps decode inside the engine's
+            # max_context contract.
+            max_new = max(1, min(max_new, cap - 1))
+        return _ContinuousRequest(
+            prompt_tokens, max_new, sample_key,
+            gen_kwargs.get("seed"), stream,
+        )
+
+    def _emit(self, req: _ContinuousRequest, token: int) -> None:
+        req.tokens.append(int(token))
+        if req.stream:
+            req.sink.put(int(token))
+
+    def _finish(self, req: _ContinuousRequest, stopped: str) -> None:
+        dt = time.time() - req.t0
+        n = len(req.tokens)
+        stats = {
+            "tokens_generated": n,
+            "seconds": round(dt, 3),
+            "tokens_per_second": round(n / max(dt, 1e-9), 1),
+            "prompt_tokens": req.prompt_tokens,
+            "stopped": stopped,
+            "slot": req.slot,
+            "admitted_step": req.admitted_step,
+            "finished_step": int(getattr(self.decoder, "steps", 0)),
+            "scheduler": "continuous",
+        }
+        self.requests_served += 1
+        req.done = True
+        if req.stream:
+            req.sink.put(stats)
+        else:
+            req.result = (req.tokens, stats)
+            req.event.set()
+
+    def _fail(self, req: _ContinuousRequest, err: BaseException) -> None:
+        req.done = True
+        if req.stream:
+            req.sink.put(err)
+        else:
+            req.error = err
+            req.event.set()
+
+    def _release(self, req: _ContinuousRequest, active: dict) -> None:
+        self.decoder.release_slot(req.slot)
+        active.pop(req.slot, None)
+
+    def _admit(self, req: _ContinuousRequest, active: dict) -> None:
+        """Prefill-then-join: the request's prompt KV lands in a freed
+        slot and its first token streams out immediately; the lane joins
+        the shared decode from the next step."""
+        if req.cancelled:
+            self._finish(req, "cancelled")
+            return
+        slot = self.decoder.acquire_slot()
+        try:
+            info = self.decoder.prefill_into_slot(
+                slot,
+                req.prompt,
+                max_new_tokens=req.max_new,
+                sample_key=req.sample_key,
+                seed=req.seed,
+            )
+        except Exception as e:
+            logger.exception("prefill-into-slot failed")
+            self.decoder.release_slot(slot)
+            self._fail(req, e)
+            return
+        req.slot = slot
+        req.prompt_tokens = int(info.get("prompt_tokens", 0))
+        req.admitted_step = int(getattr(self.decoder, "steps", 0))
+        if info.get("is_stop"):
+            self._finish(req, "eos")
+            self.decoder.release_slot(slot)
+            return
+        self._emit(req, info["token"])
+        if req.max_new <= 1:
+            self._finish(req, "length")
+            self.decoder.release_slot(slot)
+            return
+        active[slot] = req
+        self.max_batch_seen = max(self.max_batch_seen, len(active))
+
+    def _admit_queued(self, key, active: dict) -> None:
+        """Admit queued same-key requests into free slots. Once a
+        MISMATCHED-key request is waiting, admission pauses so the active
+        lanes drain and the scheduler can switch keys (no starvation)."""
+        while self.decoder.has_free_slot() and not self._pending:
+            try:
+                nxt = self.q.get_nowait()
+            except queue.Empty:
+                break
+            if nxt.sample_key == key:
+                self._admit(nxt, active)
+            else:
+                self._pending.append(nxt)
+
+    def _loop(self) -> None:
+        while True:
+            req = self._pending.pop(0) if self._pending else self.q.get()
+            try:
+                self._run_generation(req)
+            except Exception as e:  # never kill the worker
+                logger.exception("continuous scheduler generation failed")
+                if not req.done:  # the client must never hang on a bug
+                    self._fail(req, e)
+
+    def _run_generation(self, first: _ContinuousRequest) -> None:
+        self.batches += 1
+        key = first.sample_key
+        active: Dict[int, _ContinuousRequest] = {}
+        self._admit(first, active)
+        # Optional admission window: wait briefly for same-key peers so
+        # the first step already carries a batch (a latency/throughput
+        # knob, NOT required for joining — lanes join at any later step).
+        deadline = time.time() + self.window
+        while (
+            self.window > 0
+            and self.decoder.has_free_slot()
+            and not self._pending
+        ):
+            left = deadline - time.time()
+            if left <= 0:
+                break
+            try:
+                nxt = self.q.get(timeout=left)
+            except queue.Empty:
+                break
+            if nxt.sample_key == key:
+                self._admit(nxt, active)
+            else:
+                self._pending.append(nxt)
+        while active:
+            self._admit_queued(key, active)
+            if not active:
+                break
+            try:
+                toks, produced, eos = self.decoder.decode_step(key)
+            except Exception as e:
+                logger.exception("decode step failed")
+                for r in list(active.values()):
+                    self._fail(r, e)
+                    self._release(r, active)
+                return
+            for slot, r in list(active.items()):
+                if r.cancelled:
+                    self._finish(r, "cancelled")
+                    self._release(r, active)
+                    continue
+                if eos[slot]:
+                    self._finish(r, "eos")
+                    self._release(r, active)
+                    continue
+                if produced[slot]:
+                    self._emit(r, int(toks[slot]))
+                    full = getattr(self.decoder, "lane_full", None)
+                    if len(r.tokens) >= r.max_new or (
+                        full is not None and full(slot)
+                    ):
+                        self._finish(r, "length")
+                        self._release(r, active)
+
+
 class _SlotStream:
     """Event-stream wrapper that releases its concurrency slot exactly
     once — on exhaustion, error, or close(). A plain generator's finally
@@ -174,11 +494,31 @@ class ChatServer:
         max_batch: int = 8,
         batch_window_ms: float = 15.0,
         max_streams: int = 4,
+        continuous: Any = "auto",
+        num_slots: int = 8,
+        page_size: int = 128,
+        admission_window_ms: float = 0.0,
     ):
         self.engine = engine
-        self.batcher = MicroBatcher(
-            engine, max_batch=max_batch, window_ms=batch_window_ms
+        # Continuous batching (step-level admission over a slot-paged KV
+        # pool) whenever the engine exposes the step-wise decode API;
+        # duck-typed engines without it keep the legacy MicroBatcher
+        # (continuous=False forces the legacy path for A/B).
+        self.continuous = bool(
+            continuous is True
+            or (continuous == "auto" and hasattr(engine, "make_stepwise"))
         )
+        if self.continuous:
+            self.batcher = ContinuousScheduler(
+                engine,
+                num_slots=num_slots,
+                page_size=page_size,
+                admission_window_ms=admission_window_ms,
+            )
+        else:
+            self.batcher = MicroBatcher(
+                engine, max_batch=max_batch, window_ms=batch_window_ms
+            )
         # Streams bypass the MicroBatcher, so each holds its own KV cache
         # + decode loop on the device; unlike the single-worker batched
         # path they'd be unbounded without a cap (ThreadingHTTPServer is
@@ -225,13 +565,19 @@ class ChatServer:
                 "secure": self.secure,
             }
         if method == "GET" and path == "/stats":
-            return 200, {
+            out = {
                 "requests": self.requests,
                 "tokens_out": self.tokens_out,
                 "uptime_s": round(time.time() - self.t0, 1),
                 "batches": self.batcher.batches,
                 "max_batch_seen": self.batcher.max_batch_seen,
+                "scheduler": (
+                    "continuous" if self.continuous else "micro_batch"
+                ),
             }
+            if self.continuous:
+                out.update(self.batcher.stats())
+            return 200, out
         if method == "POST" and path == "/v1/auth":
             if not self.secure:
                 return 400, {"error": "server not in secure mode"}
@@ -415,11 +761,19 @@ class ChatServer:
             err = self._gate(body, token)
         if err is not None:
             return err, None
-        if not hasattr(self.engine, "generate_stream"):
+        if not self.continuous and not hasattr(
+            self.engine, "generate_stream"
+        ):
             return (501, {"error": "engine does not support streaming"}), None
         err, prompt_ids, overrides, reply_key = self._parse_request(path, body)
         if err is not None:
             return err, None
+        if self.continuous:
+            # Streams ride the shared continuous decode loop like any
+            # other request — concurrency is bounded by the KV pool's
+            # slots (excess queues), so the legacy per-stream slot cap
+            # does not apply. Closing the generator cancels the lane.
+            return None, self._stream_events(prompt_ids, overrides, reply_key)
         if not self._stream_slots.acquire(blocking=False):
             return (
                 503,
@@ -461,8 +815,15 @@ class ChatServer:
                 self.requests += 1
                 self.tokens_out += n
 
+        # Continuous mode streams per-slot out of the shared scheduler
+        # loop; legacy engines run their own chunked decode. Either source
+        # honors the same contract (token ints, then a stats dict).
+        if self.continuous:
+            src = self.batcher.submit_stream(prompt_ids, overrides)
+        else:
+            src = self.engine.generate_stream(prompt_ids, **overrides)
         try:
-            for item in self.engine.generate_stream(prompt_ids, **overrides):
+            for item in src:
                 if isinstance(item, dict):  # final stats yield
                     count(int(item.get("tokens_generated", 0)))
                     yield {
@@ -494,6 +855,9 @@ class ChatServer:
                 yield {"token": int(item), "delta": delta}
         finally:
             count(len(tokens))
+            close = getattr(src, "close", None)
+            if close is not None:
+                close()  # continuous: flags the lane cancelled
 
     # -- socket layer ------------------------------------------------------
     def make_handler(self):
@@ -632,6 +996,10 @@ def serve(
     quantize: Optional[str] = None,
     adapter: Optional[str] = None,
     kv_cache_dtype: Optional[str] = None,
+    num_slots: int = 8,
+    page_size: int = 128,
+    continuous: Any = "auto",
+    admission_window_ms: float = 0.0,
 ):
     """Build an engine from a checkpoint and serve it (CLI `serve`)."""
     from luminaai_tpu.inference.chat import ChatInterface
@@ -641,5 +1009,7 @@ def serve(
         kv_cache_dtype=kv_cache_dtype
     )
     ChatServer(
-        chat.engine, secure=secure, bootstrap_user=bootstrap_user
+        chat.engine, secure=secure, bootstrap_user=bootstrap_user,
+        continuous=continuous, num_slots=num_slots, page_size=page_size,
+        admission_window_ms=admission_window_ms,
     ).serve_forever(host, port)

@@ -32,6 +32,20 @@ from luminaai_tpu.config import Config
 logger = logging.getLogger(__name__)
 
 
+def _is_typed_key(x) -> bool:
+    return hasattr(x, "dtype") and jax.dtypes.issubdtype(
+        x.dtype, jax.dtypes.prng_key
+    )
+
+
+def _rng_to_data(rng):
+    """Typed PRNG keys (key<fry>) are not serializable by orbax's
+    StandardSave (np.asarray on them raises) — persist the underlying
+    uint32 key data and wrap it back on restore. Legacy uint32 keys pass
+    through untouched."""
+    return jax.random.key_data(rng) if _is_typed_key(rng) else rng
+
+
 class CheckpointManager:
     """Save/restore TrainState with rotation, best-k tracking and resume.
 
@@ -73,7 +87,7 @@ class CheckpointManager:
             if np.isscalar(v) or getattr(v, "ndim", 1) == 0
         }
         saveable = {"params": state.params, "opt_state": state.opt_state,
-                    "step": state.step, "rng": state.rng}
+                    "step": state.step, "rng": _rng_to_data(state.rng)}
         if step in self._mngr.all_steps():
             if not force:
                 return False  # already checkpointed (periodic duplicate)
@@ -119,18 +133,21 @@ class CheckpointManager:
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {self.dir}")
         target = {"params": state.params, "opt_state": state.opt_state,
-                  "step": state.step, "rng": state.rng}
+                  "step": state.step, "rng": _rng_to_data(state.rng)}
         restored = self._mngr.restore(
             step,
             args=ocp.args.Composite(
                 state=ocp.args.StandardRestore(target)
             ),
         )["state"]
+        rng = restored["rng"]
+        if _is_typed_key(state.rng):
+            rng = jax.random.wrap_key_data(rng)
         return state.replace(
             params=restored["params"],
             opt_state=restored["opt_state"],
             step=restored["step"],
-            rng=restored["rng"],
+            rng=rng,
         )
 
     def load_metadata(self, step: Optional[int] = None) -> Dict[str, Any]:

@@ -226,3 +226,116 @@ def test_prefetch_full_epoch_still_complete():
 
     out = list(PrefetchLoader(batches, prefetch=3))
     assert [int(b["x"][0]) for b in out] == list(range(n))
+
+
+# -- round-5 advisor findings ----------------------------------------------
+def _spec_engine(seq_length, attention_window, max_context):
+    """Tiny engine for the speculative rolling-cache regressions."""
+    from flax import linen as nn
+
+    from luminaai_tpu.inference.generate import GenerationEngine
+
+    tok = ConversationTokenizer()
+    cfg = Config(
+        vocab_size=tok.vocab_size, hidden_size=32, num_layers=1,
+        num_heads=2, num_kv_heads=2, seq_length=seq_length,
+        attention_window=attention_window, use_flash_attention=False,
+        precision="fp32", gradient_checkpointing=False, max_new_tokens=16,
+    )
+    model = LuminaTransformer(cfg)
+    params = model.init(jax.random.key(0), jnp.ones((1, 8), jnp.int32))[
+        "params"
+    ]
+    params = jax.tree.map(
+        lambda x: x.unbox() if isinstance(x, nn.meta.AxisMetadata) else x,
+        params, is_leaf=lambda x: isinstance(x, nn.meta.AxisMetadata),
+    )
+    return (
+        GenerationEngine(model, params, tok, cfg, max_context=max_context),
+        tok,
+    )
+
+
+def test_speculative_small_max_context_rolls_and_falls_back():
+    """ADVICE r5 medium: the attention layer rolls whenever the cache is
+    smaller than seq_length, but generate_speculative only engaged its
+    draft cap when the cache was smaller than MAX_CONTEXT — with
+    seq_length=512, max_context=128, window=124 a speculative request hit
+    the layer's trace-time slack ValueError (an HTTP 500) instead of the
+    promised cap/fallback. The cap condition now mirrors the layer's."""
+    engine, tok = _spec_engine(
+        seq_length=512, attention_window=124, max_context=128
+    )
+    prompt = tok.encode_text("the quick brown fox jumps over " * 3)
+    ref, _ = engine.generate(
+        prompt, max_new_tokens=12, temperature=0.0, seed=0,
+        repetition_penalty=1.0,
+    )
+    # Previously: ValueError at trace time. Now: capped draft, exact
+    # greedy sequence.
+    spec, stats = engine.generate_speculative(
+        prompt, max_new_tokens=12, draft_k=8, seed=0
+    )
+    assert spec == ref, (stats, spec, ref)
+
+
+def test_speculative_window_wider_than_context_falls_back():
+    """Zero/negative slack (window >= cache slots): speculation must fall
+    back to plain greedy decode, not crash."""
+    engine, tok = _spec_engine(
+        seq_length=512, attention_window=130, max_context=128
+    )
+    prompt = tok.encode_text("pack my box with five dozen " * 3)
+    ref, _ = engine.generate(
+        prompt, max_new_tokens=8, temperature=0.0, seed=0,
+        repetition_penalty=1.0,
+    )
+    spec, stats = engine.generate_speculative(
+        prompt, max_new_tokens=8, draft_k=8, seed=0
+    )
+    assert spec == ref
+    assert "verify_calls" not in stats  # plain-generate fallback
+
+
+def test_trim_prompt_clamps_oversized_max_new():
+    """ADVICE r5 low: max_new_tokens larger than the context budget made
+    _trim_prompt's budget non-positive and p[-max_prompt:] then KEPT an
+    over-long prompt, crashing prefill with an HTTP 500. The budget now
+    clamps to >= 1: the request serves (truncated by length) instead of
+    crashing."""
+    engine, tok = _spec_engine(
+        seq_length=64, attention_window=None, max_context=32
+    )
+    prompt = tok.encode_text("a very long prompt " * 10)
+    assert len(prompt) > 32
+    assert len(engine._trim_prompt(prompt, max_new=engine.max_context)) == 1
+    tokens, stats = engine.generate(
+        prompt, max_new_tokens=40, temperature=0.0, seed=0,
+        repetition_penalty=1.0,
+    )
+    assert isinstance(tokens, list)
+    assert stats["stopped"] in ("eos", "length")
+    # Speculative trims with max_new + draft_k slack; same clamp applies.
+    spec, _ = engine.generate_speculative(
+        prompt, max_new_tokens=40, draft_k=4, seed=0
+    )
+    assert isinstance(spec, list)
+
+
+def test_ring_attention_window_noncausal_raises_on_both_paths():
+    """ADVICE r5 low: the einsum ring silently computed a one-sided band
+    for window + non-causal while the flash path raised. Both paths now
+    raise the same ValueError."""
+    from jax.sharding import Mesh
+
+    from luminaai_tpu.ops.ring_attention import ring_attention
+
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(2), ("sequence",))
+    q = jnp.zeros((1, 8, 2, 4), jnp.float32)
+    k = jnp.zeros((1, 8, 2, 4), jnp.float32)
+    v = jnp.zeros((1, 8, 2, 4), jnp.float32)
+    for use_flash in (False, True):
+        with pytest.raises(ValueError, match="causal-only"):
+            ring_attention(
+                q, k, v, mesh, causal=False, window=4, use_flash=use_flash
+            )

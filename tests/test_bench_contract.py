@@ -424,3 +424,48 @@ def test_probe_malformed_env_budget_defaults(monkeypatch):
     monkeypatch.setenv("BENCH_PROBE_BUDGET_S", "25min")
     platform, _ = bench._probe_backend()
     assert platform == "tpu"
+
+
+# -- serving bench (--smoke-serve) -----------------------------------------
+@pytest.mark.slow
+def test_smoke_serve_emits_wellformed_continuous_metric():
+    """bench.py --smoke-serve is the hermetic CPU serving contract: one
+    JSON line with the serve_tokens_per_sec_continuous metric, the
+    latency histogram, and — the acceptance criterion — strictly more
+    tokens/sec from the continuous scheduler than from the legacy
+    MicroBatcher on the same mixed-max_new workload."""
+    import subprocess
+    import sys
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("PYTHONPATH", None)  # sitecustomize pins the tunneled backend
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(bench.__file__), "--smoke-serve"],
+        capture_output=True,
+        text=True,
+        timeout=540,
+        cwd=os.path.dirname(os.path.abspath(bench.__file__)),
+        env=env,
+    )
+    lines = [
+        l for l in proc.stdout.splitlines() if l.strip().startswith("{")
+    ]
+    assert len(lines) == 1, (proc.stdout, proc.stderr[-2000:])
+    result = json.loads(lines[0])
+    assert result["metric"] == "serve_tokens_per_sec_continuous"
+    assert "error" not in result, result
+    assert result["unit"] == "tokens/sec"
+    assert result["value"] > 0
+    ex = result["extras"]
+    assert ex["platform"] == "cpu"  # hermetic by contract
+    assert ex["legacy_tokens_per_sec"] > 0
+    # Continuous batching must beat run-to-completion micro-batching on
+    # the mixed-length workload (and both served the same token count —
+    # greedy decode is path-identical).
+    assert result["value"] > ex["legacy_tokens_per_sec"], result
+    assert result["vs_baseline"] > 1.0
+    assert ex["tokens_continuous"] == ex["tokens_legacy"] > 0
+    assert ex["slot_reuses"] >= 1
+    for hist in ("latency_ms_per_token", "ttft_ms"):
+        assert ex[hist]["p50"] > 0
+        assert ex[hist]["p95"] >= ex[hist]["p50"]

@@ -505,6 +505,249 @@ def test_concurrent_requests_ride_one_batch():
         httpd.server_close()
 
 
+# -- continuous batching ---------------------------------------------------
+class FakeStepper:
+    """Hermetic StepwiseDecoder double: deterministic token streams
+    (prompt[0], prompt[0]+1, ...) over a real PagedKVPool's slot
+    accounting, so scheduler logic (admission, eviction, reuse ordering,
+    cancellation) is testable without jax."""
+
+    def __init__(self, num_slots=2, slot_tokens=64):
+        from luminaai_tpu.inference.kv_pool import PagedKVPool
+
+        self.num_slots = num_slots
+        self.slot_tokens = slot_tokens
+        self.pool = PagedKVPool(None, num_slots, 1, slot_tokens)
+        self.steps = 0
+        self._active = [False] * num_slots
+        self._next = [0] * num_slots
+
+    def has_free_slot(self):
+        return self.pool.has_free()
+
+    def acquire_slot(self):
+        return self.pool.alloc()
+
+    def release_slot(self, slot):
+        self._active[slot] = False
+        self.pool.free(slot)
+
+    def lane_full(self, slot):
+        return False
+
+    def prefill_into_slot(self, slot, prompt, max_new_tokens=1,
+                          sample_key=None, seed=None):
+        first = int(prompt[0])
+        self._active[slot] = max_new_tokens > 1
+        self._next[slot] = first + 1
+        self.pool.lengths[slot] = len(prompt)
+        return {"token": first, "prompt_tokens": len(prompt),
+                "is_stop": False}
+
+    def decode_step(self, sample_key=None):
+        import time as _time
+
+        import numpy as np
+
+        _time.sleep(0.01)  # a "device step": keeps admission ordering real
+        toks = np.zeros((self.num_slots,), np.int64)
+        eos = np.zeros((self.num_slots,), bool)
+        produced = np.asarray(self._active, bool).copy()
+        for s in range(self.num_slots):
+            if self._active[s]:
+                toks[s] = self._next[s]
+                self._next[s] += 1
+        self.steps += 1
+        return toks, produced, eos
+
+
+class FakeContinuousEngine(FakeEngine):
+    """FakeEngine + the step-wise API surface ChatServer auto-detects."""
+
+    def __init__(self):
+        super().__init__()
+        self.stepper = FakeStepper(num_slots=2)
+
+    def _resolve_gen_key(self, mnt, temp, top_p, top_k, rep):
+        return (
+            int(mnt or 3),
+            float(0.0 if temp is None else temp),
+            int(top_k or 0),
+            float(1.0 if top_p is None else top_p),
+            float(1.0 if rep is None else rep),
+        )
+
+    def make_stepwise(self, **kw):
+        return self.stepper
+
+
+def test_paged_pool_free_list_never_double_allocates():
+    """The slot free-list is the continuous scheduler's safety invariant:
+    exhaustion raises (never hands out a live slot), free() of a
+    non-allocated slot raises, and reuse is counted."""
+    from luminaai_tpu.inference.kv_pool import PagedKVPool
+
+    pool = PagedKVPool(None, num_slots=3, pages=4, page_size=16)
+    assert pool.slot_tokens == 64
+    got = [pool.alloc() for _ in range(3)]
+    assert sorted(got) == [0, 1, 2]  # each slot handed out exactly once
+    with pytest.raises(RuntimeError):
+        pool.alloc()
+    with pytest.raises(ValueError):
+        pool.free(99)
+    pool.lengths[got[0]] = 17
+    pool.free(got[0])
+    assert pool.lengths[got[0]] == 0  # length reset on free
+    again = pool.alloc()
+    assert again == got[0]
+    assert pool.reuses == 1
+    with pytest.raises(RuntimeError):
+        pool.alloc()  # still exhausted: no phantom slots appeared
+    pool.free(again)
+    with pytest.raises(ValueError):
+        pool.free(again)  # double-free rejected
+
+
+def test_continuous_scheduler_admits_mid_decode():
+    """A queued request must join the running decode in a freed slot
+    BEFORE the longest in-flight request completes (step-level
+    admission), and every request's tokens must be its own stream."""
+    from luminaai_tpu.serving.server import ContinuousScheduler
+
+    stepper = FakeStepper(num_slots=2)
+    sched = ContinuousScheduler(FakeContinuousEngine(), decoder=stepper)
+    results = {}
+    lock = threading.Lock()
+
+    def hit(name, first_tok, max_new):
+        out = sched.submit([first_tok], {"max_new_tokens": max_new})
+        with lock:
+            results[name] = out
+
+    ta = threading.Thread(target=hit, args=("a", 100, 3))
+    tb = threading.Thread(target=hit, args=("b", 200, 40))
+    ta.start()
+    tb.start()
+    import time as _time
+
+    _time.sleep(0.05)  # let a/b occupy both slots so c queues
+    tc = threading.Thread(target=hit, args=("c", 300, 3))
+    tc.start()
+    for t in (ta, tb, tc):
+        t.join(timeout=30)
+    assert set(results) == {"a", "b", "c"}
+    toks_a, stats_a = results["a"]
+    toks_b, stats_b = results["b"]
+    toks_c, stats_c = results["c"]
+    assert toks_a == [100, 101, 102]
+    assert toks_b == list(range(200, 240))
+    assert toks_c == [300, 301, 302]
+    # c rode a freed slot while b was still decoding.
+    assert stats_c["admitted_step"] < stats_b["finished_step"]
+    assert stepper.pool.reuses >= 1
+    assert sched.max_batch_seen == 2
+
+
+def test_continuous_scheduler_switches_sampling_keys():
+    """Mismatched sampling params cannot share one traced decode step;
+    they park, the active generation drains, and the scheduler switches —
+    every request completes."""
+    from luminaai_tpu.serving.server import ContinuousScheduler
+
+    sched = ContinuousScheduler(
+        FakeContinuousEngine(), decoder=FakeStepper(num_slots=2)
+    )
+    results = []
+    lock = threading.Lock()
+
+    def hit(i):
+        out = sched.submit(
+            [50 + i], {"max_new_tokens": 4, "temperature": 0.1 * (i % 2)}
+        )
+        with lock:
+            results.append((i, out))
+
+    threads = [threading.Thread(target=hit, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert len(results) == 4
+    for i, (toks, stats) in results:
+        assert toks == [50 + i, 51 + i, 52 + i, 53 + i]
+    assert sched.batches >= 2  # at least one key switch
+
+
+def test_continuous_stream_cancel_frees_slot():
+    """Closing a continuous SSE stream flags the lane cancelled; the
+    scheduler frees its slot at the next step instead of decoding for a
+    gone client."""
+    from luminaai_tpu.serving.server import ContinuousScheduler
+
+    stepper = FakeStepper(num_slots=1)
+    sched = ContinuousScheduler(FakeContinuousEngine(), decoder=stepper)
+    gen = sched.submit_stream([70], {"max_new_tokens": 10_000})
+    assert next(gen) == 70
+    gen.close()
+    import time as _time
+
+    deadline = _time.time() + 5.0
+    while _time.time() < deadline and not stepper.pool.has_free():
+        _time.sleep(0.01)
+    assert stepper.pool.has_free(), "cancelled stream never freed its slot"
+    # The freed slot is immediately serviceable.
+    toks, stats = sched.submit([80], {"max_new_tokens": 2})
+    assert toks == [80, 81]
+
+
+def test_continuous_server_http_end_to_end():
+    """ChatServer auto-detects the step-wise engine API: generation and
+    SSE ride the continuous scheduler, /stats reports it."""
+    eng = FakeContinuousEngine()
+    srv = ChatServer(eng)
+    assert srv.continuous
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), srv.make_handler())
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        code, body = _post(url, "/v1/generate",
+                           {"prompt": "abc", "max_new_tokens": 3})
+        assert code == 200
+        assert body["text"] == "tok:97,98,99"  # ord('a'), +1, +2
+        assert body["stopped"] == "length"
+        ctype, frames = _post_sse(
+            url, "/v1/generate",
+            {"prompt": "abc", "max_new_tokens": 3, "stream": True},
+        )
+        assert ctype.startswith("text/event-stream")
+        assert frames[-1] == "[DONE]"
+        events = [json.loads(f) for f in frames[:-1]]
+        toks = [e["token"] for e in events if "token" in e]
+        assert toks == [97, 98, 99]
+        assert events[-1]["done"] is True
+        assert events[-1]["text"] == "tok:97,98,99"
+        _, stats = _get(url, "/stats")
+        assert stats["scheduler"] == "continuous"
+        assert stats["requests"] == 2
+        assert stats["kv_pool"]["num_slots"] == 2
+        assert stats["decode_steps"] >= 2
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_legacy_engine_falls_back_to_micro_batcher():
+    """Engines without the step-wise API keep the MicroBatcher path, and
+    continuous=False forces it even when the API exists."""
+    from luminaai_tpu.serving.server import MicroBatcher
+
+    srv = ChatServer(FakeEngine())
+    assert not srv.continuous and isinstance(srv.batcher, MicroBatcher)
+    srv2 = ChatServer(FakeContinuousEngine(), continuous=False)
+    assert not srv2.continuous and isinstance(srv2.batcher, MicroBatcher)
+
+
 def test_mismatched_params_requeue_not_starve():
     """Requests with different sampling params fall into separate batches
     but all complete."""
