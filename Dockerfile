@@ -39,8 +39,11 @@ RUN mkdir -p /ckpts /data /logs
 # Same port as the reference backend contract (docker-compose.dev.yml:12).
 EXPOSE 5001
 
-HEALTHCHECK --interval=30s --timeout=5s --start-period=120s \
-    CMD curl -fsS http://127.0.0.1:5001/health || exit 1
+# Readiness, not just liveness: /healthz 503s (curl -f fails) while the
+# engine is still compiling/warming and 200s with scheduler state once
+# requests can actually be served. start-period covers the first compile.
+HEALTHCHECK --interval=30s --timeout=5s --start-period=300s \
+    CMD curl -fsS http://127.0.0.1:5001/healthz || exit 1
 
 # Checkpoint auto-discovery searches the working directory, so run from
 # the mount point: any run directory mounted under /ckpts is found.

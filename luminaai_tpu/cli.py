@@ -606,6 +606,29 @@ def cmd_serve(args) -> int:
             print("--secure with no --user/--password and no existing "
                   "users.json: nobody could authenticate", file=sys.stderr)
             return 2
+    buckets = None
+    raw_buckets = getattr(args, "latency_buckets", None)
+    if raw_buckets:
+        import math
+
+        try:
+            buckets = sorted(
+                float(b) for b in raw_buckets.split(",") if b.strip()
+            )
+            # Mirror Histogram.__init__'s contract (unique finite
+            # positive) HERE, so a bad flag dies with exit 2 now instead
+            # of a ValueError traceback after minutes of checkpoint load.
+            if (
+                not buckets
+                or any(not math.isfinite(b) or b <= 0 for b in buckets)
+                or len(set(buckets)) != len(buckets)
+            ):
+                raise ValueError(raw_buckets)
+        except ValueError:
+            print(f"--latency-buckets needs unique positive "
+                  f"comma-separated seconds, got {raw_buckets!r}",
+                  file=sys.stderr)
+            return 2
     serve(
         checkpoint=args.checkpoint,
         host=args.host,
@@ -621,6 +644,10 @@ def cmd_serve(args) -> int:
         continuous=(
             False if getattr(args, "no_continuous", False) else "auto"
         ),
+        telemetry=not getattr(args, "no_telemetry", False),
+        trace_jsonl=getattr(args, "trace_jsonl", None),
+        trace_jax=getattr(args, "trace_jax", False),
+        latency_buckets=buckets,
     )
     return 0
 
@@ -1101,6 +1128,19 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--no-continuous", dest="no_continuous",
                     action="store_true",
                     help="legacy run-to-completion micro-batching")
+    sv.add_argument("--no-telemetry", dest="no_telemetry",
+                    action="store_true",
+                    help="skip hot-path metric recording (/metrics stays "
+                         "up but latency histograms stay empty)")
+    sv.add_argument("--trace-jsonl", dest="trace_jsonl",
+                    help="write request/prefill/stream spans to this "
+                         "JSONL file (tracing is off without it)")
+    sv.add_argument("--trace-jax", dest="trace_jax", action="store_true",
+                    help="mirror spans as jax.profiler TraceAnnotations "
+                         "(visible when a device trace is captured)")
+    sv.add_argument("--latency-buckets", dest="latency_buckets",
+                    help="comma-separated histogram bucket bounds in "
+                         "seconds (default spans 0.5ms..30s)")
     sv.set_defaults(fn=cmd_serve)
 
     b = sub.add_parser("benchmark", help="run the bench harness")

@@ -22,6 +22,7 @@ layer's (models/layers.py per-lane cache update).
 
 from __future__ import annotations
 
+import threading
 from typing import Any, List, Optional
 
 import numpy as np
@@ -91,6 +92,12 @@ class PagedKVPool:
         self._allocated: set = set()
         self.reuses = 0
         self.slot_uses = np.zeros((num_slots,), np.int64)
+        # Accounting lock: the scheduler worker mutates the free-list
+        # while /healthz and /metrics HTTP threads read stats() —
+        # unguarded, iterating _allocated during an alloc()/free() raises
+        # "Set changed size during iteration" and drops the probe. RLock
+        # so stats() can call the public occupancy helpers.
+        self._lock = threading.RLock()
 
     @property
     def slot_tokens(self) -> int:
@@ -107,37 +114,82 @@ class PagedKVPool:
         """Hand out a free slot. Raises when exhausted; a slot can never
         be live twice (the double-allocation class of bug that silently
         interleaves two requests' KV rows)."""
-        if not self._free:
-            raise RuntimeError("KV pool exhausted: no free slots")
-        slot = self._free.pop()
-        if slot in self._allocated:  # pragma: no cover - invariant guard
-            raise RuntimeError(f"slot {slot} double-allocated")
-        self._allocated.add(slot)
-        if self.slot_uses[slot] > 0:
-            self.reuses += 1
-        self.slot_uses[slot] += 1
-        return slot
+        with self._lock:
+            if not self._free:
+                raise RuntimeError("KV pool exhausted: no free slots")
+            slot = self._free.pop()
+            if slot in self._allocated:  # pragma: no cover - invariant guard
+                raise RuntimeError(f"slot {slot} double-allocated")
+            self._allocated.add(slot)
+            if self.slot_uses[slot] > 0:
+                self.reuses += 1
+            self.slot_uses[slot] += 1
+            return slot
 
     def free(self, slot: int) -> None:
         """Return a slot to the free-list. Stale rows are NOT zeroed —
         every consumer masks by length, and the next prefill overwrites
         the rows it needs."""
-        if slot not in self._allocated:
-            raise ValueError(f"slot {slot} is not allocated")
-        self._allocated.remove(slot)
-        self.lengths[slot] = 0
-        self._free.append(slot)
+        with self._lock:
+            if slot not in self._allocated:
+                raise ValueError(f"slot {slot} is not allocated")
+            self._allocated.remove(slot)
+            self.lengths[slot] = 0
+            self._free.append(slot)
 
     def allocated_slots(self) -> List[int]:
-        return sorted(self._allocated)
+        with self._lock:
+            return sorted(self._allocated)
+
+    # -- occupancy accounting (telemetry) --------------------------------
+    def pages_in_use(self) -> int:
+        """Pages holding live KV rows: per allocated slot, its length
+        rounded UP to whole pages (a page is the relayout/sharing unit,
+        so a 1-token tail costs a full page — that cost is exactly what
+        fragmentation_rows below makes visible)."""
+        with self._lock:
+            total = 0
+            for slot in self._allocated:
+                n = int(self.lengths[slot])
+                if n > 0:
+                    total += -(-n // self.page_size)
+            return total
+
+    def fragmentation_rows(self) -> int:
+        """Rows allocated by page rounding but not holding KV: pages_in_use
+        * page_size minus the live row count. High values mean page_size is
+        oversized for the workload's typical sequence lengths."""
+        with self._lock:
+            live = int(
+                sum(int(self.lengths[s]) for s in self._allocated)
+            )
+            return self.pages_in_use() * self.page_size - live
+
+    def _length_summary(self) -> dict:
+        """Min/mean/max live length over allocated slots (0s when idle):
+        the at-a-glance shape of what the pool is holding."""
+        with self._lock:
+            vals = [int(self.lengths[s]) for s in self._allocated]
+        if not vals:
+            return {"min": 0, "mean": 0.0, "max": 0}
+        return {
+            "min": min(vals),
+            "mean": round(sum(vals) / len(vals), 1),
+            "max": max(vals),
+        }
 
     def stats(self) -> dict:
-        return {
-            "num_slots": self.num_slots,
-            "pages": self.pages,
-            "page_size": self.page_size,
-            "slot_tokens": self.slot_tokens,
-            "in_use": len(self._allocated),
-            "free": len(self._free),
-            "reuses": self.reuses,
-        }
+        with self._lock:
+            return {
+                "num_slots": self.num_slots,
+                "pages": self.pages,
+                "page_size": self.page_size,
+                "slot_tokens": self.slot_tokens,
+                "in_use": len(self._allocated),
+                "free": len(self._free),
+                "reuses": self.reuses,
+                "pages_in_use": self.pages_in_use(),
+                "pages_total": self.num_slots * self.pages,
+                "fragmentation_rows": self.fragmentation_rows(),
+                "lengths": self._length_summary(),
+            }

@@ -1,0 +1,29 @@
+"""Monitoring: training health (logger), unified metrics (telemetry),
+and request/step tracing (tracing). telemetry.get_registry() is the
+process-wide sink serving and training both export through."""
+
+from luminaai_tpu.monitoring.logger import (
+    MetricsCollector,
+    TrainingAlert,
+    TrainingHealthMonitor,
+)
+from luminaai_tpu.monitoring.telemetry import (
+    DEFAULT_LATENCY_BUCKETS,
+    MetricsRegistry,
+    get_registry,
+    set_registry,
+)
+from luminaai_tpu.monitoring.tracing import NULL_TRACER, Span, SpanTracer
+
+__all__ = [
+    "MetricsCollector",
+    "TrainingAlert",
+    "TrainingHealthMonitor",
+    "MetricsRegistry",
+    "DEFAULT_LATENCY_BUCKETS",
+    "get_registry",
+    "set_registry",
+    "SpanTracer",
+    "Span",
+    "NULL_TRACER",
+]

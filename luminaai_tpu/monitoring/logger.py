@@ -46,12 +46,23 @@ class MetricsCollector:
         window_size: int = 100,
         loss_spike_threshold: float = 2.0,
         grad_norm_threshold: float = 100.0,
+        registry=None,
     ):
         self.window_size = window_size
         self.loss_spike_threshold = loss_spike_threshold
         self.grad_norm_threshold = grad_norm_threshold
         self.metrics: Dict[str, deque] = {}
         self.alerts: List[TrainingAlert] = []
+        # Optional bridge into the unified telemetry registry
+        # (monitoring/telemetry.py): alerts become a labeled counter on
+        # the same /metrics surface the serving stack exports.
+        self._alerts_total = None
+        if registry is not None:
+            self._alerts_total = registry.counter(
+                "training_alerts_total",
+                "Training alerts raised, by severity",
+                labelnames=("severity",),
+            )
 
     def add_metric(self, name: str, value: float, step: int) -> None:
         value = float(value)
@@ -97,6 +108,8 @@ class MetricsCollector:
     def _alert(self, severity, message, metric, value, step) -> None:
         alert = TrainingAlert(severity, message, metric, value, step)
         self.alerts.append(alert)
+        if self._alerts_total is not None:
+            self._alerts_total.labels(severity=severity).inc()
         log = logger.critical if severity == "critical" else logger.warning
         log("[%s] step %d: %s", severity.upper(), step, message)
 
@@ -167,6 +180,7 @@ class TrainingHealthMonitor:
         grad_norm_threshold: float = 100.0,
         health_check_interval: int = 100,
         wandb_config: Optional[Dict[str, Any]] = None,
+        registry: Optional[Any] = None,
     ):
         # Optional Weights & Biases mirror (ref enable_wandb). Degrades to
         # a warning when the package is absent (this image has no wandb);
@@ -184,9 +198,26 @@ class TrainingHealthMonitor:
                 )
             except Exception as e:
                 logger.warning("wandb disabled (%s); jsonl logging only", e)
+        # Unified-telemetry bridge: every scalar logged here is mirrored
+        # as a `training_<name>` gauge in the shared registry, so the
+        # serving /metrics endpoint (or any colocated exporter) exposes
+        # training health through the exact same pipe. None disables.
+        self._registry = registry
+        if registry is not None:
+            from luminaai_tpu.monitoring.telemetry import weak_callback
+
+            self._health_gauge = registry.gauge(
+                "training_health_score",
+                "Composite 0-100 training health (alerts + loss trend)",
+            )
+            # Weak ref: the process registry outlives any one monitor.
+            self._health_gauge.set_function(
+                weak_callback(self, lambda m: m.collector.get_health_score())
+            )
         self.collector = MetricsCollector(
             loss_spike_threshold=loss_spike_threshold,
             grad_norm_threshold=grad_norm_threshold,
+            registry=registry,
         )
         self.health_check_interval = health_check_interval
         self.phase = "warmup"
@@ -224,6 +255,8 @@ class TrainingHealthMonitor:
             scalars[k] = f
         self.collector.add_metrics(scalars, step)
         self._update_phase(step, scalars)
+        if self._registry is not None:
+            self._mirror_to_registry(step, scalars)
 
         if self.log_path is not None:
             with self.log_path.open("a") as f:
@@ -233,6 +266,33 @@ class TrainingHealthMonitor:
                 self._wandb.log(scalars, step=step)
             except Exception:  # never let telemetry kill training
                 pass
+
+    @staticmethod
+    def _metric_name(key: str) -> str:
+        """Logged scalar key -> valid exposition metric name."""
+        safe = "".join(
+            c if (c.isalnum() or c == "_") else "_" for c in key
+        ).strip("_") or "unnamed"
+        return f"training_{safe}"
+
+    def _mirror_to_registry(self, step: int, scalars: Dict[str, float]) -> None:
+        import math as _math
+
+        r = self._registry
+        for k, v in scalars.items():
+            if not _math.isfinite(v):
+                continue  # NaN/Inf are alert material, not gauge values
+            try:
+                r.gauge(
+                    self._metric_name(k), f"Training scalar '{k}' (latest)"
+                ).set(v)
+            except ValueError:
+                # A scalar key colliding with an existing non-gauge metric
+                # must not kill training; the jsonl log still has it.
+                continue
+        r.gauge(
+            "training_step", "Latest logged global step"
+        ).set(step)
 
     def _update_phase(self, step: int, metrics: Dict[str, float]) -> None:
         """Rough phase model (ref logger.py:340 _update_training_phase)."""

@@ -17,6 +17,12 @@ endpoints either way.
 
 Endpoints:
   GET  /health            liveness + model info (ref HEALTHCHECK contract)
+  GET  /healthz           readiness: 503 while the engine is warming/
+                          compiling, 200 + scheduler state once serving
+                          (the Dockerfile HEALTHCHECK target)
+  GET  /metrics           Prometheus text exposition of the process
+                          registry (serving histograms, KV-pool gauges,
+                          training counters when colocated)
   GET  /stats             session counters
   POST /v1/generate       {"prompt": str, "max_new_tokens"?, "temperature"?,
                            "top_p"?, "top_k"?} → {"text", "tokens", ...}
@@ -43,6 +49,14 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
+
+from luminaai_tpu.monitoring.telemetry import (
+    DEFAULT_LATENCY_BUCKETS,
+    MetricsRegistry,
+    get_registry,
+    weak_callback,
+)
+from luminaai_tpu.monitoring.tracing import NULL_TRACER, SpanTracer
 
 logger = logging.getLogger(__name__)
 
@@ -191,6 +205,10 @@ class ContinuousScheduler:
         admission_window_ms: float = 0.0,
         max_slot_tokens: Optional[int] = None,
         decoder=None,
+        registry: Optional[MetricsRegistry] = None,
+        tracer: Optional[SpanTracer] = None,
+        telemetry: bool = True,
+        latency_buckets=DEFAULT_LATENCY_BUCKETS,
     ):
         self.engine = engine
         self.decoder = decoder or engine.make_stepwise(
@@ -207,8 +225,95 @@ class ContinuousScheduler:
         self.max_batch_seen = 0
         self.requests_served = 0
         self._pending: List[_ContinuousRequest] = []
+        self._init_telemetry(registry, tracer, telemetry, latency_buckets)
         self._worker = threading.Thread(target=self._loop, daemon=True)
         self._worker.start()
+
+    def _init_telemetry(self, registry, tracer, telemetry, buckets) -> None:
+        """Registry wiring: per-request latency histograms (recorded on
+        the hot path only when `telemetry` — the off switch is the A/B
+        for the overhead budget test) plus pull-time KV-pool gauges,
+        which cost nothing until /metrics is scraped."""
+        self.telemetry = bool(telemetry)
+        self.registry = registry or get_registry()
+        self.tracer = tracer or NULL_TRACER
+        r = self.registry
+        self._m_queue_wait = r.histogram(
+            "serve_queue_wait_seconds",
+            "Submit-to-admission wait (slot contention + key parking)",
+            buckets=buckets,
+        )
+        self._m_prefill = r.histogram(
+            "serve_prefill_seconds",
+            "prefill_into_slot duration (prompt KV write + first token)",
+            buckets=buckets,
+        )
+        self._m_ttft = r.histogram(
+            "serve_ttft_seconds",
+            "Submit-to-first-token latency per request",
+            buckets=buckets,
+        )
+        self._m_step = r.histogram(
+            "serve_decode_step_seconds",
+            "One scheduler decode step (all active lanes, one jit call)",
+            buckets=buckets,
+        )
+        self._m_token = r.histogram(
+            "serve_token_latency_seconds",
+            "Per-token decode latency (step duration, one observation "
+            "per lane that produced a token)",
+            buckets=buckets,
+        )
+        self._m_admissions = r.counter(
+            "serve_admissions_total", "Requests admitted into a KV slot"
+        )
+        self._m_evictions = r.counter(
+            "serve_evictions_total",
+            "Slots released (finished, cancelled, or failed lanes)",
+        )
+        self._m_generations = r.counter(
+            "serve_generations_total",
+            "Generations started (one sampling key each)",
+        )
+        self._m_decode_steps = r.counter(
+            "serve_decode_steps_total", "Scheduler decode steps executed"
+        )
+        # Callback gauges hold WEAK refs: the process registry outlives
+        # any one scheduler, and a strong closure would pin a replaced
+        # scheduler's whole KV pool and export its stale state forever.
+        r.gauge(
+            "serve_active_lanes", "Lanes currently decoding"
+        ).set_function(weak_callback(self, lambda s: s._active_lanes))
+        r.gauge(
+            "serve_queue_depth",
+            "Requests waiting for admission (queued + key-parked)",
+        ).set_function(weak_callback(self, lambda s: s.queue_depth()))
+        self._active_lanes = 0
+        pool = getattr(self.decoder, "pool", None)
+        if pool is not None and hasattr(pool, "stats"):
+            def pool_gauge(name, help_text, key):
+                r.gauge(name, help_text).set_function(
+                    weak_callback(pool, lambda p: p.stats().get(key, 0))
+                )
+
+            pool_gauge("kv_pool_slots_in_use", "KV pool slots allocated",
+                       "in_use")
+            pool_gauge("kv_pool_slots_free", "KV pool slots free", "free")
+            pool_gauge("kv_pool_slot_reuses_total",
+                       "Times a previously-used slot was re-issued",
+                       "reuses")
+            pool_gauge("kv_pool_pages_in_use",
+                       "Pages holding live KV rows", "pages_in_use")
+            pool_gauge("kv_pool_pages_total", "Total pool pages",
+                       "pages_total")
+            pool_gauge(
+                "kv_pool_fragmentation_rows",
+                "Rows lost to page rounding (allocated but not live)",
+                "fragmentation_rows",
+            )
+
+    def queue_depth(self) -> int:
+        return self.q.qsize() + len(self._pending)
 
     # -- public API --------------------------------------------------------
     def submit(
@@ -250,6 +355,8 @@ class ContinuousScheduler:
             "batches": self.batches,
             "max_batch_seen": self.max_batch_seen,
             "decode_steps": int(getattr(self.decoder, "steps", 0)),
+            "active_lanes": self._active_lanes,
+            "queue_depth": self.queue_depth(),
         }
         pool = getattr(self.decoder, "pool", None)
         if pool is not None and hasattr(pool, "stats"):
@@ -327,9 +434,18 @@ class ContinuousScheduler:
             req.error = err
             req.event.set()
 
+    def _release_slot(self, slot: int) -> None:
+        """Single choke point for giving a slot back: the decoder free +
+        the eviction count must never drift apart across the four
+        release sites."""
+        self.decoder.release_slot(slot)
+        if self.telemetry:
+            self._m_evictions.inc()
+
     def _release(self, req: _ContinuousRequest, active: dict) -> None:
-        self.decoder.release_slot(req.slot)
+        self._release_slot(req.slot)
         active.pop(req.slot, None)
+        self._active_lanes = len(active)
 
     def _admit(self, req: _ContinuousRequest, active: dict) -> None:
         """Prefill-then-join: the request's prompt KV lands in a freed
@@ -339,32 +455,47 @@ class ContinuousScheduler:
             self._finish(req, "cancelled")
             return
         slot = self.decoder.acquire_slot()
+        t_admit = time.perf_counter()
+        if self.telemetry:
+            # Queue wait = submit to slot acquisition: covers both slot
+            # contention and sampling-key parking.
+            self._m_queue_wait.observe(max(0.0, time.time() - req.t0))
+            self._m_admissions.inc()
         try:
-            info = self.decoder.prefill_into_slot(
-                slot,
-                req.prompt,
-                max_new_tokens=req.max_new,
-                sample_key=req.sample_key,
-                seed=req.seed,
-            )
+            with self.tracer.span(
+                "prefill", slot=slot, prompt_tokens=len(req.prompt)
+            ):
+                info = self.decoder.prefill_into_slot(
+                    slot,
+                    req.prompt,
+                    max_new_tokens=req.max_new,
+                    sample_key=req.sample_key,
+                    seed=req.seed,
+                )
         except Exception as e:
             logger.exception("prefill-into-slot failed")
-            self.decoder.release_slot(slot)
+            self._release_slot(slot)
             self._fail(req, e)
             return
+        if self.telemetry:
+            now = time.perf_counter()
+            self._m_prefill.observe(now - t_admit)
+            # First token is sampled inside prefill, so TTFT lands here.
+            self._m_ttft.observe(max(0.0, time.time() - req.t0))
         req.slot = slot
         req.prompt_tokens = int(info.get("prompt_tokens", 0))
         req.admitted_step = int(getattr(self.decoder, "steps", 0))
         if info.get("is_stop"):
             self._finish(req, "eos")
-            self.decoder.release_slot(slot)
+            self._release_slot(slot)
             return
         self._emit(req, info["token"])
         if req.max_new <= 1:
             self._finish(req, "length")
-            self.decoder.release_slot(slot)
+            self._release_slot(slot)
             return
         active[slot] = req
+        self._active_lanes = len(active)
         self.max_batch_seen = max(self.max_batch_seen, len(active))
 
     def _admit_queued(self, key, active: dict) -> None:
@@ -393,6 +524,8 @@ class ContinuousScheduler:
 
     def _run_generation(self, first: _ContinuousRequest) -> None:
         self.batches += 1
+        if self.telemetry:
+            self._m_generations.inc()
         key = first.sample_key
         active: Dict[int, _ContinuousRequest] = {}
         self._admit(first, active)
@@ -421,13 +554,24 @@ class ContinuousScheduler:
             if not active:
                 break
             try:
+                t_step = time.perf_counter()
                 toks, produced, eos = self.decoder.decode_step(key)
+                step_dt = time.perf_counter() - t_step
             except Exception as e:
                 logger.exception("decode step failed")
                 for r in list(active.values()):
                     self._fail(r, e)
                     self._release(r, active)
                 return
+            if self.telemetry:
+                self._m_step.observe(step_dt)
+                self._m_decode_steps.inc()
+                n_produced = sum(
+                    1 for slot in active if produced[slot]
+                )
+                # Per-token decode latency: the step IS the inter-token
+                # gap for every lane that emitted this step.
+                self._m_token.observe(step_dt, count=max(0, n_produced))
             for slot, r in list(active.items()):
                 if r.cancelled:
                     self._finish(r, "cancelled")
@@ -498,8 +642,24 @@ class ChatServer:
         num_slots: int = 8,
         page_size: int = 128,
         admission_window_ms: float = 0.0,
+        registry: Optional[MetricsRegistry] = None,
+        tracer: Optional[SpanTracer] = None,
+        telemetry: bool = True,
+        latency_buckets=DEFAULT_LATENCY_BUCKETS,
+        warmup: bool = False,
     ):
         self.engine = engine
+        self.telemetry = bool(telemetry)
+        self.registry = registry or get_registry()
+        self.tracer = tracer or NULL_TRACER
+        # Readiness gate for /healthz: a container probe must see 503
+        # while XLA is still compiling the prefill/decode executables
+        # (minutes for real models) and flip to 200 the moment requests
+        # can actually be served. warmup=True (the `serve` entrypoint)
+        # drives a tiny generation through the real batcher path in the
+        # background and sets the gate when it completes; in-process
+        # embedders/tests default to immediately-ready.
+        self._ready = threading.Event()
         # Continuous batching (step-level admission over a slot-paged KV
         # pool) whenever the engine exposes the step-wise decode API;
         # duck-typed engines without it keep the legacy MicroBatcher
@@ -514,11 +674,44 @@ class ChatServer:
                 num_slots=num_slots,
                 page_size=page_size,
                 admission_window_ms=admission_window_ms,
+                registry=self.registry,
+                tracer=self.tracer,
+                telemetry=telemetry,
+                latency_buckets=latency_buckets,
             )
         else:
             self.batcher = MicroBatcher(
                 engine, max_batch=max_batch, window_ms=batch_window_ms
             )
+        r = self.registry
+        self._m_http = r.counter(
+            "serve_http_requests_total",
+            "HTTP requests by route and status code",
+            labelnames=("route", "code"),
+        )
+        self._m_request = r.histogram(
+            "serve_request_seconds",
+            "Non-streaming generation request latency (parse to reply)",
+            buckets=latency_buckets,
+        )
+        self._m_stream = r.histogram(
+            "serve_stream_duration_seconds",
+            "SSE stream duration (first event to close/abort)",
+            buckets=latency_buckets,
+        )
+        self._m_tokens_out = r.counter(
+            "serve_tokens_out_total", "Generated tokens returned to clients"
+        )
+        r.gauge(
+            "serve_ready",
+            "1 once the engine is warmed and serving, 0 while compiling",
+        ).set_function(
+            weak_callback(self, lambda s: float(s._ready.is_set()))
+        )
+        if warmup:
+            threading.Thread(target=self._warmup, daemon=True).start()
+        else:
+            self._ready.set()
         # Streams bypass the MicroBatcher, so each holds its own KV cache
         # + decode loop on the device; unlike the single-worker batched
         # path they'd be unbounded without a cap (ThreadingHTTPServer is
@@ -547,10 +740,79 @@ class ChatServer:
                 user, password = bootstrap_user
                 self.security.create_user(user, password)
 
+    # -- readiness ---------------------------------------------------------
+    def mark_ready(self) -> None:
+        self._ready.set()
+
+    def _warmup(self) -> None:
+        """Compile-priming generation through the real batcher path (the
+        same executables production requests hit), then open the /healthz
+        gate. A warmup failure still opens the gate — a server that can
+        answer SOME requests beats one a probe kills forever — but logs
+        loudly and leaves the failure visible in the health payload."""
+        self._warmup_error: Optional[str] = None
+        t0 = time.time()
+        try:
+            encode = getattr(
+                getattr(self.engine.tokenizer, "backend", None),
+                "encode", None,
+            )
+            prompt = encode("warmup") if callable(encode) else [1, 2, 3]
+            with self.tracer.span("warmup"):
+                self.batcher.submit(
+                    list(prompt) or [1],
+                    {"max_new_tokens": 2, "temperature": 0.0},
+                )
+            logger.info("warmup generation done in %.1fs", time.time() - t0)
+        except Exception as e:
+            logger.exception("warmup generation failed; serving anyway")
+            self._warmup_error = f"{type(e).__name__}: {e}"
+        finally:
+            self._ready.set()
+
+    def _scheduler_state(self) -> Dict[str, Any]:
+        """Live scheduler occupancy for /healthz and /stats consumers."""
+        if self.continuous:
+            st = self.batcher.stats()
+            return {
+                "scheduler": "continuous",
+                "active_lanes": st.get("active_lanes", 0),
+                "queue_depth": st.get("queue_depth", 0),
+                "slots_free": st.get("kv_pool", {}).get("free"),
+                "decode_steps": st.get("decode_steps", 0),
+            }
+        return {
+            "scheduler": "micro_batch",
+            "queue_depth": self.batcher.q.qsize(),
+            "batches": self.batcher.batches,
+        }
+
+    def render_metrics(self) -> str:
+        return self.registry.render_prometheus()
+
     # -- request handling --------------------------------------------------
     def handle(self, method: str, path: str, body: Dict[str, Any],
                token: Optional[str]) -> tuple:
         """Returns (status_code, payload dict). Pure-ish: no socket I/O."""
+        if method == "GET" and path == "/healthz":
+            # Readiness (vs /health's liveness): 503 while the engine is
+            # compiling/warming so orchestrators hold traffic, 200 with
+            # scheduler occupancy once serving. The Dockerfile
+            # HEALTHCHECK curls this route.
+            if not self._ready.is_set():
+                return 503, {
+                    "status": "warming",
+                    "uptime_s": round(time.time() - self.t0, 1),
+                }
+            out = {
+                "status": "ok",
+                "uptime_s": round(time.time() - self.t0, 1),
+                **self._scheduler_state(),
+            }
+            warm_err = getattr(self, "_warmup_error", None)
+            if warm_err:
+                out["warmup_error"] = warm_err
+            return 200, out
         if method == "GET" and path == "/health":
             cfg = self.engine.config
             return 200, {
@@ -698,6 +960,10 @@ class ChatServer:
         with self.state_lock:
             self.requests += 1
             self.tokens_out += n_tok
+        if self.telemetry:
+            self._m_request.observe(time.time() - t0)
+            self._m_tokens_out.inc(n_tok)
+        self.mark_ready()  # a served request is proof of readiness
         out.update(
             tokens=n_tok,
             latency_s=round(time.time() - t0, 3),
@@ -805,6 +1071,8 @@ class ChatServer:
         tokens: List[int] = []
         base = 0  # tokens[:base] are flushed into deltas already
         counted = False
+        stream_span = self.tracer.span("sse_stream", route=reply_key)
+        span = stream_span.__enter__()
 
         def count(n: int) -> None:
             nonlocal counted
@@ -814,6 +1082,11 @@ class ChatServer:
             with self.state_lock:
                 self.requests += 1
                 self.tokens_out += n
+            if self.telemetry:
+                self._m_stream.observe(time.time() - t0)
+                self._m_tokens_out.inc(n)
+            span.set(tokens=n)
+            self.mark_ready()
 
         # Continuous mode streams per-slot out of the shared scheduler
         # loop; legacy engines run their own chunked decode. Either source
@@ -855,6 +1128,7 @@ class ChatServer:
                 yield {"token": int(item), "delta": delta}
         finally:
             count(len(tokens))
+            stream_span.__exit__(None, None, None)
             close = getattr(src, "close", None)
             if close is not None:
                 close()  # continuous: flags the lane cancelled
@@ -867,10 +1141,38 @@ class ChatServer:
             def log_message(self, fmt, *args):  # route to logging, not stderr
                 logger.info("%s %s", self.address_string(), fmt % args)
 
+            _KNOWN_ROUTES = (
+                "/", "/chat", "/health", "/healthz", "/metrics", "/stats",
+                "/v1/generate", "/v1/chat", "/v1/auth",
+            )
+
+            def _count(self, code: int) -> None:
+                if server.telemetry:
+                    # Unknown paths collapse into one label value: a
+                    # scanner probing random routes must not be able to
+                    # mint unbounded label cardinality.
+                    route = self.path.split("?", 1)[0]
+                    if route not in self._KNOWN_ROUTES:
+                        route = "<other>"
+                    server._m_http.labels(
+                        route=route, code=str(code)
+                    ).inc()
+
             def _reply(self, code: int, payload: Dict[str, Any]) -> None:
+                self._count(code)
                 data = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _reply_text(self, code: int, text: str,
+                            content_type: str) -> None:
+                self._count(code)
+                data = text.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
@@ -883,6 +1185,16 @@ class ChatServer:
                 # Health probes often add query strings (cache busting);
                 # route on the bare path.
                 path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    # Prometheus text exposition: the one non-JSON API
+                    # route. Rendered outside handle() so a scrape can
+                    # never be confused with a model request.
+                    self._reply_text(
+                        200,
+                        server.render_metrics(),
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                    return
                 if path in ("/", "/chat"):
                     # Built-in chat page (the ref's Electron app role —
                     # serving/webui.py). Static: auth gates the API calls
@@ -912,6 +1224,7 @@ class ChatServer:
                     # before headers raises BrokenPipeError, and the
                     # handler below must still events.close() or the
                     # stream slot leaks (permanent 503s at the cap).
+                    self._count(200)
                     self.send_response(200)
                     self.send_header("Content-Type", "text/event-stream")
                     self.send_header("Cache-Control", "no-cache")
@@ -955,21 +1268,25 @@ class ChatServer:
                     return
                 path = self.path.split("?", 1)[0]
                 try:
-                    if (
-                        body.get("stream")
-                        and path in ("/v1/generate", "/v1/chat")
+                    with server.tracer.span(
+                        "http_request", route=path,
+                        stream=bool(body.get("stream")),
                     ):
-                        err, events = server.start_stream(
-                            path, body, self._token()
+                        if (
+                            body.get("stream")
+                            and path in ("/v1/generate", "/v1/chat")
+                        ):
+                            err, events = server.start_stream(
+                                path, body, self._token()
+                            )
+                            if err is not None:
+                                self._reply(*err)
+                            else:
+                                self._reply_sse(events)
+                            return
+                        code, payload = server.handle(
+                            "POST", path, body, self._token()
                         )
-                        if err is not None:
-                            self._reply(*err)
-                        else:
-                            self._reply_sse(events)
-                        return
-                    code, payload = server.handle(
-                        "POST", path, body, self._token()
-                    )
                 except Exception as e:  # surface as 500, keep serving
                     logger.exception("request failed")
                     code, payload = 500, {"error": str(e)}
@@ -1000,6 +1317,10 @@ def serve(
     page_size: int = 128,
     continuous: Any = "auto",
     admission_window_ms: float = 0.0,
+    telemetry: bool = True,
+    trace_jsonl: Optional[str] = None,
+    trace_jax: bool = False,
+    latency_buckets=None,
 ):
     """Build an engine from a checkpoint and serve it (CLI `serve`)."""
     from luminaai_tpu.inference.chat import ChatInterface
@@ -1008,8 +1329,24 @@ def serve(
         checkpoint_dir=checkpoint, quantize=quantize, adapter=adapter,
         kv_cache_dtype=kv_cache_dtype
     )
+    tracer = NULL_TRACER
+    if trace_jsonl or trace_jax:
+        tracer = SpanTracer(
+            jsonl_path=trace_jsonl, use_jax_profiler=trace_jax
+        )
     ChatServer(
         chat.engine, secure=secure, bootstrap_user=bootstrap_user,
         continuous=continuous, num_slots=num_slots, page_size=page_size,
         admission_window_ms=admission_window_ms,
+        telemetry=telemetry,
+        tracer=tracer,
+        latency_buckets=(
+            tuple(latency_buckets)
+            if latency_buckets
+            else DEFAULT_LATENCY_BUCKETS
+        ),
+        # Real checkpoints compile for minutes: gate /healthz behind a
+        # background warmup generation so probes hold traffic until the
+        # executables exist.
+        warmup=True,
     ).serve_forever(host, port)

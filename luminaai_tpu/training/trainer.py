@@ -31,6 +31,8 @@ from jax.sharding import NamedSharding
 from luminaai_tpu.config import Config
 from luminaai_tpu.models.transformer import LuminaTransformer
 from luminaai_tpu.monitoring.logger import TrainingHealthMonitor
+from luminaai_tpu.monitoring.telemetry import MetricsRegistry, get_registry
+from luminaai_tpu.monitoring.tracing import NULL_TRACER, SpanTracer
 from luminaai_tpu.parallel.mesh import build_mesh, describe_mesh, initialize_multihost
 from luminaai_tpu.parallel.sharding import (
     batch_spec,
@@ -106,6 +108,8 @@ class Trainer:
         checkpoint_dir: Optional[str] = None,
         total_steps: Optional[int] = None,
         steps_per_epoch: Optional[int] = None,
+        registry: Optional[MetricsRegistry] = None,
+        tracer: Optional[SpanTracer] = None,
     ):
         self.config = config
         self.train_data = train_data
@@ -142,11 +146,40 @@ class Trainer:
 
         ckpt_dir = checkpoint_dir or f"{config.output_dir}/checkpoints"
         self.checkpoints = CheckpointManager(config, ckpt_dir)
+        # Unified telemetry: the same process-wide registry the serving
+        # stack exports through /metrics, so training step/throughput/
+        # recompile counters and health gauges ride one exposition path.
+        self.registry = registry or get_registry()
+        self.tracer = tracer or NULL_TRACER
+        r = self.registry
+        self._m_steps = r.counter(
+            "train_steps_total", "Optimizer steps executed this process"
+        )
+        self._m_tokens = r.counter(
+            "train_tokens_total", "Tokens consumed by executed train steps"
+        )
+        self._m_recompiles = r.counter(
+            "train_recompiles_total",
+            "Train-step rebuilds forcing an XLA recompile, by cause",
+            labelnames=("reason",),
+        )
+        self._m_step_time = r.histogram(
+            "train_step_seconds",
+            "Per-step wall time, averaged over each log window",
+            # Train steps span ~10ms (debug CPU) to minutes (flagship
+            # first-compile windows); latency buckets would clip them.
+            buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                     10.0, 30.0, 60.0, 120.0),
+        )
+        self._m_tps = r.gauge(
+            "train_tokens_per_sec", "Throughput over the last log window"
+        )
         self.monitor = TrainingHealthMonitor(
             log_dir=f"{config.output_dir}/logs",
             loss_spike_threshold=config.loss_spike_threshold,
             grad_norm_threshold=config.grad_norm_threshold,
             health_check_interval=config.health_check_interval,
+            registry=self.registry,
             wandb_config={
                 "enable": config.enable_wandb,
                 "project": config.wandb_project,
@@ -212,7 +245,18 @@ class Trainer:
         return True
 
     def save_checkpoint(self, metrics=None, force: bool = False) -> None:
-        self.checkpoints.save(self.state, self.global_step, metrics, force=force)
+        with self.tracer.span("checkpoint_save", step=self.global_step):
+            self.checkpoints.save(
+                self.state, self.global_step, metrics, force=force
+            )
+
+    def _count_recompile(self, reason: str) -> None:
+        """Every train-step rebuild retraces + recompiles; the counter
+        makes the recompile *rate* a first-class exported signal (pjit
+        TPU stacks treat compile count as a health metric — a hot
+        intervention loop shows up here before it shows up as lost
+        throughput)."""
+        self._m_recompiles.labels(reason=reason or "config_change").inc()
 
     # -- adaptive hooks (called by the orchestrator) ----------------------
     def adjust_learning_rate(self, new_lr: float, reason: str = "") -> None:
@@ -228,6 +272,7 @@ class Trainer:
         self.train_step = make_train_step(
             cfg, self.model, self.shardings, self.mesh, sched, self.tx
         )
+        self._count_recompile("lr_override")
         self._interventions.append(
             {"step": self.global_step, "kind": "lr_override", "lr": new_lr,
              "reason": reason}
@@ -304,6 +349,7 @@ class Trainer:
         self.eval_step = make_eval_step(
             cfg, self.model, self.shardings, self.mesh
         )
+        self._count_recompile("expert_evolution")
         logger.warning(
             "%s -> %d experts (%s); optimizer moments reset", action, new_E, reason
         )
@@ -342,7 +388,7 @@ class Trainer:
                 )
                 return False
             cfg.pipeline_microbatches = new_micro
-            self._rebuild_steps()
+            self._rebuild_steps("microbatch_split")
             logger.warning(
                 "pipeline microbatch split: %d -> %d (%s)", old, new_micro,
                 reason,
@@ -361,7 +407,7 @@ class Trainer:
             return False
         old = cfg.gradient_accumulation_steps
         cfg.gradient_accumulation_steps = new_accum
-        self._rebuild_steps()
+        self._rebuild_steps("microbatch_split")
         logger.warning(
             "microbatch split: accum %d -> %d (%s)", old, new_accum, reason
         )
@@ -412,7 +458,7 @@ class Trainer:
                 new_accum -= 1
         cfg.batch_size = new_batch_size
         cfg.gradient_accumulation_steps = new_accum
-        self._rebuild_steps()
+        self._rebuild_steps("batch_size")
         self._batch_sharding = NamedSharding(self.mesh, batch_spec())
         logger.warning(
             "batch size %d -> %d (accum %d -> %d) (%s)",
@@ -435,7 +481,7 @@ class Trainer:
             return
         old = cfg.capacity_factor
         cfg.capacity_factor = float(new_factor)
-        self._rebuild_steps()
+        self._rebuild_steps("capacity_factor")
         logger.warning(
             "capacity factor %.2f -> %.2f (%s)", old, new_factor, reason
         )
@@ -453,7 +499,7 @@ class Trainer:
             return
         old = cfg.routing_temperature
         cfg.routing_temperature = float(new_temp)
-        self._rebuild_steps()
+        self._rebuild_steps("routing_temperature")
         logger.warning(
             "routing temperature %.2f -> %.2f (%s)", old, new_temp, reason
         )
@@ -495,7 +541,7 @@ class Trainer:
             )
         old = cfg.mod_capacity_factor
         cfg.mod_capacity_factor = new_capacity
-        self._rebuild_steps()
+        self._rebuild_steps("mod_capacity")
         logger.warning(
             "MoD capacity %.2f -> %.2f (%s)", old, new_capacity, reason
         )
@@ -524,6 +570,7 @@ class Trainer:
             cfg, self.model, self.shardings, self.mesh,
             self._active_schedule, self.tx,
         )
+        self._count_recompile("expert_dropout")
         logger.warning("expert dropout %.2f -> %.2f (%s)", old, rate, reason)
         self._interventions.append(
             {"step": self.global_step, "kind": "expert_dropout",
@@ -545,13 +592,14 @@ class Trainer:
             self.config, self.model, self.shardings, self.mesh,
             self._active_schedule, self.tx,
         )
+        self._count_recompile("weight_decay")
         logger.warning("weight decay %.3g -> %.3g (%s)", old, new_wd, reason)
         self._interventions.append(
             {"step": self.global_step, "kind": "weight_decay",
              "from": old, "to": new_wd, "reason": reason}
         )
 
-    def _rebuild_steps(self) -> None:
+    def _rebuild_steps(self, reason: str = "config_change") -> None:
         """Recompile train/eval steps against the (mutated) config. Param
         and optimizer trees are untouched — only traced constants and
         microbatch shapes changed."""
@@ -562,6 +610,7 @@ class Trainer:
         self.eval_step = make_eval_step(
             self.config, self.model, self.shardings, self.mesh
         )
+        self._count_recompile(reason)
 
     def train_with_oom_protection(
         self, max_attempts: Optional[int] = None
@@ -609,6 +658,7 @@ class Trainer:
             self.config, self.model, self.shardings, self.mesh,
             self._active_schedule, self.tx,
         )
+        self._count_recompile("grad_clip")
         logger.warning("grad clip %.3g -> %.3g (%s)", old, norm, reason)
         self._interventions.append(
             {"step": self.global_step, "kind": "grad_clip", "from": old,
@@ -685,14 +735,16 @@ class Trainer:
             return {}
         totals: Dict[str, float] = {}
         count = 0
-        for i, batch in enumerate(self.eval_data()):
-            if i >= max_batches:
-                break
-            metrics = self.eval_step(self.state, self._put(batch))
-            for k, v in metrics.items():
-                if getattr(v, "ndim", 1) == 0:
-                    totals[k] = totals.get(k, 0.0) + float(v)
-            count += 1
+        with self.tracer.span("evaluate", step=self.global_step) as sp:
+            for i, batch in enumerate(self.eval_data()):
+                if i >= max_batches:
+                    break
+                metrics = self.eval_step(self.state, self._put(batch))
+                for k, v in metrics.items():
+                    if getattr(v, "ndim", 1) == 0:
+                        totals[k] = totals.get(k, 0.0) + float(v)
+                count += 1
+            sp.set(batches=count)
         if count == 0:
             return {}
         out = {f"eval_{k}": v / count for k, v in totals.items()}
@@ -719,6 +771,7 @@ class Trainer:
         self._run_start_step = self.global_step
         window_t0 = time.time()
         window_tokens = 0
+        window_steps = 0
         while not stop and self.global_step < self.total_steps:
             epoch += 1
             for batch in self._device_prefetch(self.train_data()):
@@ -731,11 +784,15 @@ class Trainer:
                 n_tok = int(batch["input_ids"].size)
                 tokens_seen += n_tok
                 window_tokens += n_tok
+                window_steps += 1
+                self._m_steps.inc()
+                self._m_tokens.inc(n_tok)
                 if first_step:
                     # Sync out the XLA compile, then restart the window so
                     # the first tokens_per_sec isn't dominated by compile.
                     float(metrics["loss"])
-                    window_t0, window_tokens = time.time(), 0
+                    self._count_recompile("initial_compile")
+                    window_t0, window_tokens, window_steps = time.time(), 0, 0
 
                 if self.global_step % log_every == 0:
                     scalars = {
@@ -747,7 +804,16 @@ class Trainer:
                     scalars["tokens_per_sec"] = window_tokens / max(
                         now - window_t0, 1e-9
                     )
-                    window_t0, window_tokens = now, 0
+                    if window_steps > 0:
+                        # Whole-window measurement (the float() above was
+                        # the sync): mean step time observed once per step
+                        # in the window, so histogram counts = steps.
+                        self._m_step_time.observe(
+                            (now - window_t0) / window_steps,
+                            count=window_steps,
+                        )
+                    self._m_tps.set(scalars["tokens_per_sec"])
+                    window_t0, window_tokens, window_steps = now, 0, 0
                     self.monitor.log_step(self.global_step, scalars)
                     last_metrics = scalars
                     if self.step_callback is not None:
@@ -790,7 +856,7 @@ class Trainer:
                         stop = True
                         break
                     # Eval time isn't train throughput; restart the window.
-                    window_t0, window_tokens = time.time(), 0
+                    window_t0, window_tokens, window_steps = time.time(), 0, 0
 
                 overdue_backup = (
                     cfg.backup_every_n_hours > 0
@@ -806,7 +872,7 @@ class Trainer:
                 ):
                     self.save_checkpoint(last_metrics, force=overdue_backup)
                     self._last_backup_time = time.time()
-                    window_t0, window_tokens = time.time(), 0
+                    window_t0, window_tokens, window_steps = time.time(), 0, 0
 
             if (
                 self.steps_per_epoch is not None

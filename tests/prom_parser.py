@@ -1,0 +1,129 @@
+"""Minimal Prometheus text-format (0.0.4) parser — test-side contract
+check for the /metrics exposition. Deliberately dependency-free: the
+point is proving our output round-trips through an INDEPENDENT reading
+of the format rules, not through our own renderer's inverse."""
+
+import re
+
+_SAMPLE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r"\s+(?P<value>\S+)\s*$"
+)
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape(v: str) -> str:
+    return (
+        v.replace(r"\n", "\n").replace(r"\"", '"').replace(r"\\", "\\")
+    )
+
+
+def _value(s: str) -> float:
+    if s == "+Inf":
+        return float("inf")
+    if s == "-Inf":
+        return float("-inf")
+    return float(s)
+
+
+def parse_prometheus_text(text: str):
+    """Parse exposition text into
+    {family: {"type": str|None, "help": str|None, "samples": [...]}} with
+    each sample a (sample_name, labels_dict, value) triple. Histogram
+    samples (`_bucket`/`_sum`/`_count` suffixes) attach to their family
+    name. Raises ValueError on any line that is neither a comment, a
+    blank, nor a well-formed sample — a strict parser is the contract.
+    """
+    families = {}
+
+    def fam(name):
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in families:
+                base = name[: -len(suffix)]
+                break
+        return families.setdefault(
+            base, {"type": None, "help": None, "samples": []}
+        )
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(" ", 3)
+            if len(parts) < 3:
+                raise ValueError(f"line {lineno}: malformed HELP: {line!r}")
+            name = parts[2]
+            families.setdefault(
+                name, {"type": None, "help": None, "samples": []}
+            )["help"] = parts[3] if len(parts) > 3 else ""
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            if len(parts) != 4 or parts[3] not in (
+                "counter", "gauge", "histogram", "summary", "untyped"
+            ):
+                raise ValueError(f"line {lineno}: malformed TYPE: {line!r}")
+            families.setdefault(
+                parts[2], {"type": None, "help": None, "samples": []}
+            )["type"] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue  # free-form comment
+        m = _SAMPLE.match(line)
+        if m is None:
+            raise ValueError(f"line {lineno}: malformed sample: {line!r}")
+        labels = {}
+        raw = m.group("labels")
+        if raw:
+            consumed = 0
+            for lm in _LABEL.finditer(raw):
+                labels[lm.group(1)] = _unescape(lm.group(2))
+                consumed += 1
+            if consumed != len([c for c in raw.split(",") if c.strip()]):
+                raise ValueError(f"line {lineno}: malformed labels: {raw!r}")
+        fam(m.group("name"))["samples"].append(
+            (m.group("name"), labels, _value(m.group("value")))
+        )
+    return families
+
+
+def check_histogram_wellformed(family_name: str, family: dict) -> None:
+    """Assert-style invariants for one histogram family, per label set:
+    buckets cumulative and nondecreasing in le order, +Inf present and
+    equal to _count, _sum present."""
+    by_labels = {}
+    for name, labels, value in family["samples"]:
+        key = tuple(
+            sorted((k, v) for k, v in labels.items() if k != "le")
+        )
+        entry = by_labels.setdefault(
+            key, {"buckets": [], "sum": None, "count": None}
+        )
+        if name.endswith("_bucket"):
+            entry["buckets"].append((_value(labels["le"]), value))
+        elif name.endswith("_sum"):
+            entry["sum"] = value
+        elif name.endswith("_count"):
+            entry["count"] = value
+        else:
+            raise AssertionError(
+                f"{family_name}: stray sample {name} in histogram family"
+            )
+    assert by_labels, f"{family_name}: histogram with no samples"
+    for key, entry in by_labels.items():
+        buckets = sorted(entry["buckets"])
+        assert buckets, f"{family_name}{key}: no buckets"
+        assert buckets[-1][0] == float("inf"), (
+            f"{family_name}{key}: missing +Inf bucket"
+        )
+        counts = [c for _, c in buckets]
+        assert all(
+            a <= b for a, b in zip(counts, counts[1:])
+        ), f"{family_name}{key}: bucket counts not cumulative: {counts}"
+        assert entry["count"] == counts[-1], (
+            f"{family_name}{key}: _count {entry['count']} != +Inf bucket "
+            f"{counts[-1]}"
+        )
+        assert entry["sum"] is not None, f"{family_name}{key}: missing _sum"

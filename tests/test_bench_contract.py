@@ -469,3 +469,12 @@ def test_smoke_serve_emits_wellformed_continuous_metric():
     for hist in ("latency_ms_per_token", "ttft_ms"):
         assert ex[hist]["p50"] > 0
         assert ex[hist]["p95"] >= ex[hist]["p50"]
+    # Telemetry provenance contract: the artifact embeds a registry
+    # snapshot (bench.py fails loudly without one), and its serving
+    # histograms saw the measured workload with monotone quantiles.
+    telem = ex["telemetry"]
+    for hist in ("serve_ttft_seconds", "serve_token_latency_seconds"):
+        assert telem[hist]["count"] > 0, hist
+        assert telem[hist]["p50"] <= telem[hist]["p95"] <= telem[hist]["p99"]
+    assert telem["serve_admissions_total"] >= ex["requests"]
+    assert telem["kv_pool_slot_reuses_total"] >= 1

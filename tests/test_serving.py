@@ -779,3 +779,245 @@ def test_mismatched_params_requeue_not_starve():
     finally:
         httpd.shutdown()
         httpd.server_close()
+
+
+# -- telemetry: /healthz, /metrics, parity, overhead ------------------------
+def test_healthz_warming_then_ready():
+    """/healthz is the READINESS probe: 503 while the engine is
+    compiling/warming (so the Dockerfile HEALTHCHECK holds traffic),
+    200 with scheduler state once serving."""
+    srv = ChatServer(FakeContinuousEngine())
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), srv.make_handler())
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        srv._ready.clear()  # simulate mid-compile
+        code, body = _post(url, "/healthz", {})  # POST -> 404 route check
+        assert code == 404
+        try:
+            _get(url, "/healthz")
+            assert False, "expected 503"
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            assert json.loads(e.read())["status"] == "warming"
+        # /health (liveness) stays 200 while warming; only readiness gates.
+        code, _ = _get(url, "/health")
+        assert code == 200
+        srv.mark_ready()
+        code, body = _get(url, "/healthz?probe=1")
+        assert code == 200 and body["status"] == "ok"
+        assert body["scheduler"] == "continuous"
+        assert body["active_lanes"] == 0
+        assert body["queue_depth"] == 0
+        assert body["slots_free"] == 2
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_healthz_warmup_flow_marks_ready():
+    """warmup=True starts not-ready, drives a generation through the real
+    batcher path in the background, and flips the gate when it completes."""
+    import time as _time
+
+    srv = ChatServer(FakeContinuousEngine(), warmup=True)
+    assert srv._ready.wait(timeout=10), "warmup never marked ready"
+    assert srv.batcher.requests_served >= 1  # warmup used the real path
+    code, body = srv.handle("GET", "/healthz", {}, None)
+    assert code == 200 and body["status"] == "ok"
+    assert "warmup_error" not in body
+    _time.sleep(0)
+
+
+def test_healthz_warmup_failure_still_serves():
+    """A broken warmup must not brick the server: the gate opens anyway
+    and the failure is surfaced in the health payload."""
+
+    class BrokenPrefill(FakeStepper):
+        def prefill_into_slot(self, *a, **kw):
+            raise RuntimeError("compile exploded")
+
+    eng = FakeContinuousEngine()
+    eng.stepper = BrokenPrefill(num_slots=2)
+    srv = ChatServer(eng, warmup=True)
+    assert srv._ready.wait(timeout=10)
+    code, body = srv.handle("GET", "/healthz", {}, None)
+    assert code == 200
+    assert "compile exploded" in body.get("warmup_error", "")
+
+
+def test_healthz_micro_batcher_state():
+    srv = ChatServer(FakeEngine())
+    code, body = srv.handle("GET", "/healthz", {}, None)
+    assert code == 200
+    assert body["scheduler"] == "micro_batch"
+    assert body["queue_depth"] == 0
+
+
+def test_metrics_endpoint_round_trips_and_covers_serving():
+    """GET /metrics on a running server returns valid Prometheus text
+    exposition (independent minimal parser) including the serving
+    histograms (TTFT, per-token decode), KV-pool gauges, and — with a
+    colocated training monitor on the same registry — training series.
+    The acceptance-criterion test for the unified sink."""
+    from luminaai_tpu.monitoring.logger import TrainingHealthMonitor
+    from luminaai_tpu.monitoring.telemetry import MetricsRegistry
+    from prom_parser import check_histogram_wellformed, parse_prometheus_text
+
+    registry = MetricsRegistry()
+    srv = ChatServer(FakeContinuousEngine(), registry=registry)
+    # Training flows into the SAME registry (the unified-sink contract).
+    monitor = TrainingHealthMonitor(registry=registry)
+    monitor.log_step(5, {"loss": 2.0, "grad_norm": 0.5})
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), srv.make_handler())
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        # Generate some traffic: one batched request + one SSE stream.
+        code, _ = _post(url, "/v1/generate",
+                        {"prompt": "abc", "max_new_tokens": 3})
+        assert code == 200
+        _post_sse(url, "/v1/generate",
+                  {"prompt": "abd", "max_new_tokens": 3, "stream": True})
+        with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+            assert r.status == 200
+            ctype = r.headers.get("Content-Type", "")
+            text = r.read().decode()
+        assert ctype.startswith("text/plain")
+        assert "version=0.0.4" in ctype
+
+        families = parse_prometheus_text(text)  # strict: raises on junk
+        for name, fam in families.items():
+            assert fam["type"] is not None, f"{name} missing TYPE"
+            # A labeled family with no children yet (e.g. an alert
+            # counter before any alert) legally renders TYPE-only.
+
+        # Serving histograms saw the traffic.
+        for hist in ("serve_ttft_seconds", "serve_token_latency_seconds",
+                     "serve_prefill_seconds", "serve_queue_wait_seconds",
+                     "serve_decode_step_seconds",
+                     "serve_stream_duration_seconds"):
+            assert families[hist]["type"] == "histogram", hist
+            check_histogram_wellformed(hist, families[hist])
+        ttft_count = [
+            v for (n, l, v) in families["serve_ttft_seconds"]["samples"]
+            if n.endswith("_count")
+        ]
+        assert ttft_count == [2]  # both requests measured
+
+        # KV-pool gauges are exported.
+        for g in ("kv_pool_slots_in_use", "kv_pool_slots_free",
+                  "kv_pool_pages_in_use", "kv_pool_fragmentation_rows"):
+            assert families[g]["type"] == "gauge", g
+        (_, _, free), = families["kv_pool_slots_free"]["samples"]
+        assert free == 2  # all slots released after completion
+
+        # Training series ride the same exposition.
+        (_, _, loss), = families["training_loss"]["samples"]
+        assert loss == 2.0
+        assert families["training_health_score"]["type"] == "gauge"
+
+        # HTTP counter carries route/code labels.
+        http = {
+            (l["route"], l["code"]): v
+            for (_, l, v) in families["serve_http_requests_total"]["samples"]
+        }
+        assert http[("/v1/generate", "200")] == 2
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_decode_parity_with_telemetry_on_off():
+    """Telemetry must be observation-only: the exact token streams come
+    out of the continuous scheduler with recording on and off (the
+    acceptance-criterion parity check)."""
+    from luminaai_tpu.monitoring.telemetry import MetricsRegistry
+    from luminaai_tpu.serving.server import ContinuousScheduler
+
+    outs = {}
+    for on in (True, False):
+        sched = ContinuousScheduler(
+            FakeContinuousEngine(),
+            decoder=FakeStepper(num_slots=2),
+            registry=MetricsRegistry(),
+            telemetry=on,
+        )
+        results = {}
+        lock = threading.Lock()
+
+        def hit(name, first_tok, max_new, sched=sched, results=results,
+                lock=lock):
+            out = sched.submit([first_tok], {"max_new_tokens": max_new})
+            with lock:
+                results[name] = out[0]
+
+        threads = [
+            threading.Thread(target=hit, args=(f"r{i}", 100 + 10 * i, 3 + i))
+            for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        outs[on] = results
+    assert outs[True] == outs[False]
+    for i in range(4):
+        first = 100 + 10 * i
+        assert outs[True][f"r{i}"] == list(range(first, first + 3 + i))
+
+
+@pytest.mark.slow
+def test_telemetry_overhead_within_budget():
+    """Scheduler A/B with metrics on vs off: recording must stay inside
+    budget. The fake stepper does no sleeping, so the workload is almost
+    PURE scheduler overhead — the harshest possible ratio; the real
+    decode step is orders of magnitude heavier."""
+    import time as _time
+
+    from luminaai_tpu.monitoring.telemetry import MetricsRegistry
+    from luminaai_tpu.serving.server import ContinuousScheduler
+
+    class FastStepper(FakeStepper):
+        def decode_step(self, sample_key=None):
+            import numpy as np
+
+            toks = np.zeros((self.num_slots,), np.int64)
+            eos = np.zeros((self.num_slots,), bool)
+            produced = np.asarray(self._active, bool).copy()
+            for s in range(self.num_slots):
+                if self._active[s]:
+                    toks[s] = self._next[s]
+                    self._next[s] += 1
+            self.steps += 1
+            return toks, produced, eos
+
+    def run_once(telemetry_on):
+        sched = ContinuousScheduler(
+            FakeContinuousEngine(),
+            decoder=FastStepper(num_slots=4),
+            registry=MetricsRegistry(),
+            telemetry=telemetry_on,
+        )
+        t0 = _time.perf_counter()
+        threads = [
+            threading.Thread(
+                target=sched.submit,
+                args=([50 + i], {"max_new_tokens": 500}),
+            )
+            for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        return _time.perf_counter() - t0
+
+    # Interleave and take mins to shed scheduler-timing noise.
+    on = min(run_once(True) for _ in range(3))
+    off = min(run_once(False) for _ in range(3))
+    # Budget: recording may cost at most 50% on a zero-work decode step
+    # plus a 20ms absolute floor for timer jitter.
+    assert on <= off * 1.5 + 0.02, (on, off)

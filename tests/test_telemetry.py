@@ -1,0 +1,352 @@
+"""Unified telemetry: registry semantics, Prometheus exposition
+round-trip (via the independent minimal parser in prom_parser.py),
+histogram quantile monotonicity, span tracing, and the training-monitor
+bridge into the shared registry."""
+
+import json
+import math
+import random
+import threading
+
+import pytest
+
+from luminaai_tpu.monitoring.telemetry import (
+    DEFAULT_LATENCY_BUCKETS,
+    MetricsRegistry,
+    get_registry,
+    set_registry,
+)
+from luminaai_tpu.monitoring.tracing import NULL_TRACER, SpanTracer
+from prom_parser import check_histogram_wellformed, parse_prometheus_text
+
+
+# -- registry semantics ------------------------------------------------------
+def test_counter_and_gauge_semantics():
+    r = MetricsRegistry()
+    c = r.counter("events_total", "events")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    with pytest.raises(ValueError):
+        c.inc(-1)  # counters are monotone
+    g = r.gauge("depth", "queue depth")
+    g.set(3)
+    g.inc()
+    g.dec(2)
+    assert g.value == 2
+    g.set_function(lambda: 42)
+    assert g.value == 42
+    # A raising callback degrades to NaN, never an exception.
+    g.set_function(lambda: 1 / 0)
+    assert math.isnan(g.value)
+
+
+def test_labels_and_conflicts():
+    r = MetricsRegistry()
+    c = r.counter("http_total", "reqs", labelnames=("route", "code"))
+    c.labels(route="/a", code="200").inc()
+    c.labels(route="/a", code="200").inc()
+    c.labels(route="/b", code="500").inc()
+    assert c.labels(route="/a", code="200").value == 2
+    with pytest.raises(ValueError):
+        c.labels(route="/a")  # missing label
+    with pytest.raises(ValueError):
+        c.inc()  # labeled family needs .labels()
+    # get-or-create returns the SAME family; type conflicts raise.
+    assert r.counter("http_total", labelnames=("route", "code")) is c
+    with pytest.raises(ValueError):
+        r.gauge("http_total")
+    with pytest.raises(ValueError):
+        r.counter("http_total", labelnames=("route",))
+    # Names colliding with histogram exposition suffixes are rejected.
+    with pytest.raises(ValueError):
+        r.counter("foo_bucket")
+
+
+def test_histogram_buckets_and_bulk_observe():
+    r = MetricsRegistry()
+    h = r.histogram("lat_seconds", "latency", buckets=(0.01, 0.1, 1.0))
+    h.observe(0.005)
+    h.observe(0.01)  # le is inclusive: lands in the 0.01 bucket
+    h.observe(0.05, count=3)
+    h.observe(5.0)
+    assert h.count == 6
+    assert h.sum == pytest.approx(0.005 + 0.01 + 3 * 0.05 + 5.0)
+    counts, total_sum, total = h._sole()._frozen()
+    assert counts == [2, 3, 0, 1]  # (<=0.01, <=0.1, <=1.0, +Inf)
+    with pytest.raises(ValueError):
+        r.histogram("bad", buckets=(1.0, 1.0))  # duplicate bounds
+    with pytest.raises(ValueError):
+        r.histogram("bad2", buckets=(float("inf"),))  # +Inf is implicit
+
+
+def test_histogram_quantiles_monotone_property():
+    """Quantiles from bucket interpolation must be monotone in q and
+    bounded by the data's bucket span — property-tested over random
+    workloads (the ISSUE's monotonicity contract)."""
+    rng = random.Random(7)
+    for trial in range(20):
+        r = MetricsRegistry()
+        h = r.histogram(
+            f"h{trial}", buckets=DEFAULT_LATENCY_BUCKETS
+        )
+        n = rng.randint(1, 400)
+        for _ in range(n):
+            # log-uniform over (1e-5, 100): exercises underflow bucket,
+            # mid buckets, and the +Inf overflow bucket.
+            h.observe(10 ** rng.uniform(-5, 2))
+        qs = [h.quantile(q / 100.0) for q in range(0, 101, 2)]
+        assert all(v is not None for v in qs)
+        assert all(a <= b + 1e-12 for a, b in zip(qs, qs[1:])), (
+            trial, qs,
+        )
+        assert qs[0] >= 0.0
+        assert qs[-1] <= max(DEFAULT_LATENCY_BUCKETS)
+    # Empty histogram: quantiles are None, never a crash.
+    r = MetricsRegistry()
+    h = r.histogram("empty")
+    assert h.quantile(0.5) is None
+    assert h.quantiles() == {"p50": None, "p95": None, "p99": None}
+
+
+def test_histogram_quantile_exact_at_boundaries():
+    r = MetricsRegistry()
+    h = r.histogram("hb", buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.5, 3.0, 3.5):
+        h.observe(v)
+    # rank q*N at a bucket edge interpolates to the bucket bound.
+    assert h.quantile(0.25) == pytest.approx(1.0)
+    assert h.quantile(0.5) == pytest.approx(2.0)
+    assert h.quantile(1.0) == pytest.approx(4.0)
+
+
+def test_registry_thread_safety():
+    r = MetricsRegistry()
+    c = r.counter("n_total")
+    h = r.histogram("v_seconds", buckets=(0.5,))
+
+    def worker():
+        for _ in range(1000):
+            c.inc()
+            h.observe(0.1)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 8000
+    assert h.count == 8000
+
+
+# -- Prometheus exposition round-trip ---------------------------------------
+def _populated_registry():
+    r = MetricsRegistry()
+    c = r.counter("rt_requests_total", "reqs", labelnames=("route", "code"))
+    c.labels(route="/v1/generate", code="200").inc(7)
+    c.labels(route='/w"eird\npath', code="500").inc()  # escaping path
+    r.gauge("rt_depth", "depth").set(3.5)
+    r.counter("rt_plain_total", "unlabeled").inc(2)
+    h = r.histogram("rt_lat_seconds", "lat", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.02, 0.02, 0.5, 3.0):
+        h.observe(v)
+    hl = r.histogram(
+        "rt_step_seconds", "labeled hist", buckets=(0.1, 1.0),
+        labelnames=("phase",),
+    )
+    hl.labels(phase="prefill").observe(0.05)
+    hl.labels(phase="decode").observe(0.5, count=4)
+    return r
+
+
+def test_prometheus_text_round_trip():
+    """The exposition must round-trip through an independent minimal
+    parser: every family typed, every sample parseable, histogram
+    invariants (cumulative buckets, +Inf == _count) hold, and parsed
+    values match the live registry."""
+    r = _populated_registry()
+    text = r.render_prometheus()
+    families = parse_prometheus_text(text)
+
+    assert families["rt_requests_total"]["type"] == "counter"
+    assert families["rt_depth"]["type"] == "gauge"
+    assert families["rt_lat_seconds"]["type"] == "histogram"
+    for name, fam in families.items():
+        assert fam["type"] is not None, f"{name} missing TYPE"
+        assert fam["samples"], f"{name} has no samples"
+
+    by_labels = {
+        tuple(sorted(labels.items())): v
+        for (_, labels, v) in families["rt_requests_total"]["samples"]
+    }
+    assert by_labels[
+        (("code", "200"), ("route", "/v1/generate"))
+    ] == 7
+    assert by_labels[
+        (("code", "500"), ("route", '/w"eird\npath'))
+    ] == 1
+    (_, _, depth), = families["rt_depth"]["samples"]
+    assert depth == 3.5
+
+    check_histogram_wellformed(
+        "rt_lat_seconds", families["rt_lat_seconds"]
+    )
+    check_histogram_wellformed(
+        "rt_step_seconds", families["rt_step_seconds"]
+    )
+    # Spot-check cumulative counts against the observations above.
+    buckets = {
+        labels["le"]: v
+        for (name, labels, v) in families["rt_lat_seconds"]["samples"]
+        if name.endswith("_bucket")
+    }
+    assert buckets["0.01"] == 1
+    assert buckets["0.1"] == 3
+    assert buckets["1"] == 4
+    assert buckets["+Inf"] == 5
+
+
+def test_snapshot_shape():
+    r = _populated_registry()
+    snap = r.snapshot()
+    snap = json.loads(json.dumps(snap))  # must be JSON-serializable
+    assert snap["rt_plain_total"] == 2
+    assert snap["rt_depth"] == 3.5
+    assert snap["rt_lat_seconds"]["count"] == 5
+    assert snap["rt_lat_seconds"]["p50"] is not None
+    assert (
+        snap["rt_requests_total"]["code=200,route=/v1/generate"] == 7
+    )
+
+
+def test_default_registry_swap():
+    fresh = MetricsRegistry()
+    prev = set_registry(fresh)
+    try:
+        assert get_registry() is fresh
+    finally:
+        set_registry(prev)
+    assert get_registry() is prev
+
+
+# -- tracing -----------------------------------------------------------------
+def test_tracer_nesting_and_jsonl_export(tmp_path):
+    path = tmp_path / "spans.jsonl"
+    tracer = SpanTracer(jsonl_path=str(path))
+    with tracer.span("request", route="/v1/chat") as outer:
+        with tracer.span("prefill", slot=2) as inner:
+            inner.set(prompt_tokens=11)
+        outer.set(tokens=3)
+    tracer.close()
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert [l["name"] for l in lines] == ["prefill", "request"]
+    prefill, request = lines
+    assert prefill["parent"] == request["span"]
+    assert prefill["trace"] == request["trace"]
+    assert request["parent"] is None
+    assert prefill["attrs"] == {"slot": 2, "prompt_tokens": 11}
+    assert request["attrs"] == {"route": "/v1/chat", "tokens": 3}
+    assert prefill["duration_s"] >= 0
+    assert request["duration_s"] >= prefill["duration_s"]
+
+
+def test_tracer_error_capture_and_new_trace_per_root(tmp_path):
+    tracer = SpanTracer(jsonl_path=str(tmp_path / "s.jsonl"))
+    with pytest.raises(RuntimeError):
+        with tracer.span("boom"):
+            raise RuntimeError("dead device")
+    with tracer.span("ok"):
+        pass
+    boom, ok = tracer.recent("boom")[0], tracer.recent("ok")[0]
+    assert "dead device" in boom.error
+    assert ok.error is None
+    assert boom.trace_id != ok.trace_id  # separate roots = separate traces
+
+
+def test_tracer_threads_do_not_share_stacks(tmp_path):
+    tracer = SpanTracer(jsonl_path=str(tmp_path / "t.jsonl"))
+    parents = []
+
+    def worker():
+        with tracer.span("w") as s:
+            parents.append(s.parent_id)
+
+    with tracer.span("main"):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    # The worker's span must be a ROOT (its thread had no open span),
+    # not a child of "main" on the other thread.
+    assert parents == [None]
+
+
+def test_disabled_tracer_is_free_and_null():
+    assert not NULL_TRACER.enabled
+    with NULL_TRACER.span("anything", x=1) as s:
+        s.set(y=2)  # no-op, no error
+    assert NULL_TRACER.spans_recorded == 0
+
+
+def test_tracer_unwritable_path_degrades(tmp_path):
+    bad = tmp_path / "f"
+    bad.write_text("")  # a FILE where a directory is needed
+    tracer = SpanTracer(jsonl_path=str(bad / "x" / "s.jsonl"))
+    with tracer.span("still_works"):
+        pass
+    assert tracer.spans_recorded == 1  # memory ring still records
+
+
+# -- training monitor bridge -------------------------------------------------
+def test_health_monitor_mirrors_into_registry(tmp_path):
+    from luminaai_tpu.monitoring.logger import TrainingHealthMonitor
+
+    r = MetricsRegistry()
+    mon = TrainingHealthMonitor(log_dir=str(tmp_path), registry=r)
+    mon.log_step(10, {"loss": 2.5, "grad_norm": 1.0, "weird key!": 7.0})
+    snap = r.snapshot()
+    assert snap["training_loss"] == 2.5
+    assert snap["training_grad_norm"] == 1.0
+    assert snap["training_weird_key"] == 7.0  # sanitized name
+    assert snap["training_step"] == 10
+    assert 0.0 <= snap["training_health_score"] <= 100.0
+    # NaN loss raises a critical alert -> labeled counter; the gauge
+    # keeps its last finite value.
+    mon.log_step(11, {"loss": float("nan")})
+    snap = r.snapshot()
+    assert snap["training_alerts_total"]["severity=critical"] == 1
+    assert snap["training_loss"] == 2.5
+    # The jsonl sink is untouched by the bridge.
+    logged = (tmp_path / "metrics.jsonl").read_text().splitlines()
+    assert len(logged) == 2
+
+
+def test_health_monitor_without_registry_unchanged(tmp_path):
+    from luminaai_tpu.monitoring.logger import TrainingHealthMonitor
+
+    mon = TrainingHealthMonitor(log_dir=str(tmp_path))
+    mon.log_step(1, {"loss": 1.0})
+    assert mon.collector.get_metric_summary("loss")["current"] == 1.0
+
+
+# -- kv pool occupancy accounting -------------------------------------------
+def test_kv_pool_pages_and_fragmentation():
+    from luminaai_tpu.inference.kv_pool import PagedKVPool
+
+    pool = PagedKVPool(None, num_slots=3, pages=4, page_size=16)
+    st = pool.stats()
+    assert st["pages_in_use"] == 0
+    assert st["pages_total"] == 12
+    assert st["fragmentation_rows"] == 0
+    a = pool.alloc()
+    b = pool.alloc()
+    pool.lengths[a] = 17  # 2 pages, 32 rows allocated, 15 slack
+    pool.lengths[b] = 16  # exactly 1 page, 0 slack
+    st = pool.stats()
+    assert st["pages_in_use"] == 3
+    assert st["fragmentation_rows"] == 15
+    assert st["lengths"] == {"min": 16, "mean": 16.5, "max": 17}
+    pool.free(a)
+    st = pool.stats()
+    assert st["pages_in_use"] == 1
+    assert st["fragmentation_rows"] == 0
+    assert st["lengths"] == {"min": 16, "mean": 16.0, "max": 16}
