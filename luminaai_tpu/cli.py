@@ -53,6 +53,7 @@ def _apply_overrides(cfg, args) -> None:
         ("sp", "sequence_parallel_size"),
         ("moe_dispatch", "moe_dispatch"),
         ("attention_window", "attention_window"),
+        ("profile_dir", "profile_dir"),
     ]:
         val = getattr(args, flag, None)
         if val is not None:
@@ -61,6 +62,21 @@ def _apply_overrides(cfg, args) -> None:
         cfg.use_moe = False
     if getattr(args, "no_flash", False):
         cfg.use_flash_attention = False
+    # Windowed in-run profiling (docs/observability.md "Attribution"):
+    # --profile-steps N captures a device trace for N steps (starting at
+    # --profile-start, default step 3 so the compile step never pollutes
+    # the window) and exports the per-subsystem breakdown. Either flag
+    # alone enables the window — --profile-start without --profile-steps
+    # uses the config's profile_num_steps (default 3), never a silent
+    # no-op.
+    if getattr(args, "profile_start", None):
+        cfg.profile_start_step = args.profile_start
+    if getattr(args, "profile_steps", None):
+        cfg.profile_num_steps = args.profile_steps
+        if not cfg.profile_start_step:
+            cfg.profile_start_step = 3
+    if getattr(args, "cost_analysis", False):
+        cfg.compiled_cost_analysis = True
     # Axis-implied settings (ring attention under sp, scan_layers and the
     # grad-accum fold under pp) — one shared code path on Config.
     cfg.normalize_parallelism()
@@ -885,6 +901,7 @@ def cmd_report(args) -> int:
 def cmd_diagnose(args) -> int:
     from luminaai_tpu.utils.environment import (
         check_config_fits,
+        connectivity_probe,
         format_diagnostics,
         recommend_preset,
         tpu_runtime_diagnostics,
@@ -907,6 +924,24 @@ def cmd_diagnose(args) -> int:
             print(f"    {k}: {v}")
     if rt["backend"]["status"] != "ok":
         return 1
+    # ICI/DCN connectivity: per-host device visibility + a timed
+    # all-reduce per mesh axis, exported as diagnose_* registry gauges
+    # (VERDICT "What's missing" #3; the reference's scripts/net.sh role).
+    # Only after the backend probe answered ok — see above.
+    try:
+        conn = connectivity_probe()
+        print("[connectivity]")
+        for section, vals in conn.items():
+            print(f"  {section}:")
+            for k, v in vals.items():
+                print(f"    {k}: {v}")
+        if not conn["visibility"]["visibility_ok"]:
+            print(
+                "    WARNING: global devices != process_count * local "
+                "devices — a host is missing part of the slice"
+            )
+    except Exception as e:
+        print(f"connectivity probe unavailable: {e}")
     try:
         print(f"recommended preset for this fleet: {recommend_preset()}")
         if args.preset:
@@ -1014,6 +1049,27 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument(
             "--auto-hardware", action="store_true",
             help="optimize parallelism for detected devices",
+        )
+        prof = sp.add_argument_group(
+            "performance attribution (docs/observability.md)"
+        )
+        prof.add_argument(
+            "--profile-steps", dest="profile_steps", type=int,
+            help="capture a jax.profiler trace for N steps and export the "
+                 "per-subsystem step breakdown (gauges + attribution.jsonl)",
+        )
+        prof.add_argument(
+            "--profile-start", dest="profile_start", type=int,
+            help="first profiled step (default 3: skip the compile step)",
+        )
+        prof.add_argument(
+            "--profile-dir", dest="profile_dir",
+            help="trace output dir (default OUTPUT_DIR/profile)",
+        )
+        prof.add_argument(
+            "--cost-analysis", dest="cost_analysis", action="store_true",
+            help="export XLA compiled-cost gauges (flops/bytes/HBM) and "
+                 "the analytic-vs-compiled MFU cross-check at first compile",
         )
         par = sp.add_argument_group("parallelism (docs/parallelism.md)")
         par.add_argument("--dp", type=int, help="data axis (-1 = auto)")

@@ -218,9 +218,19 @@ class Config:
     output_dir: str = "experiments"
     # Capture a jax.profiler device trace (TensorBoard XPlane) for steps
     # [profile_start_step, profile_start_step + profile_num_steps) into
-    # output_dir/profile. 0 disables (SURVEY §5 tracing).
+    # profile_dir (default output_dir/profile). 0 disables (SURVEY §5
+    # tracing). After the window closes the trainer runs the attribution
+    # classifier (monitoring/attribution.py) over the trace and exports
+    # the per-subsystem breakdown as registry gauges + attribution.jsonl.
     profile_start_step: int = 0
     profile_num_steps: int = 3
+    profile_dir: Optional[str] = None
+    # AOT-query XLA's cost model for the compiled train step at first
+    # compile (compiled_flops_per_step / bytes_accessed / HBM-footprint
+    # gauges + the analytic-vs-compiled MFU cross-check). Off by default:
+    # the AOT lower+compile is a second compile of the step program
+    # (cheap only where the persistent compile cache is warm).
+    compiled_cost_analysis: bool = False
     seed: int = 42
     log_level: str = "INFO"
     save_total_limit: int = 5

@@ -1100,6 +1100,25 @@ class StepwiseDecoder:
             "is_stop": is_stop,
         }
 
+    def step_fn_and_args(
+        self, sample_key: Optional[Tuple] = None
+    ) -> Tuple[Any, Tuple]:
+        """The jitted decode-step function and the argument tuple
+        decode_step would call it with right now. Exposed so
+        monitoring/attribution.py can AOT-lower the decode executable for
+        compiled-cost accounting without executing a step."""
+        fn = self._get_step(sample_key or GREEDY_SAMPLE_KEY)
+        args = (
+            self.params,
+            self.pool.caches,
+            jnp.asarray(self._tokens),
+            jnp.asarray(self._pos),
+            jnp.asarray(self._active),
+            self._counts,
+            self._rngs,
+        )
+        return fn, args
+
     def decode_step(
         self, sample_key: Optional[Tuple] = None
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -1109,16 +1128,8 @@ class StepwiseDecoder:
         matching generate()) and were deactivated — the scheduler frees
         their slots."""
         was_active = self._active.copy()
-        fn = self._get_step(sample_key or GREEDY_SAMPLE_KEY)
-        caches, nxt, eos, counts, rngs = fn(
-            self.params,
-            self.pool.caches,
-            jnp.asarray(self._tokens),
-            jnp.asarray(self._pos),
-            jnp.asarray(self._active),
-            self._counts,
-            self._rngs,
-        )
+        fn, fn_args = self.step_fn_and_args(sample_key)
+        caches, nxt, eos, counts, rngs = fn(*fn_args)
         self.pool.caches = caches
         self._counts = counts
         self._rngs = rngs

@@ -1,7 +1,17 @@
 """Monitoring: training health (logger), unified metrics (telemetry),
-and request/step tracing (tracing). telemetry.get_registry() is the
-process-wide sink serving and training both export through."""
+request/step tracing (tracing), and performance attribution
+(attribution: compiled-cost accounting + per-subsystem trace
+breakdown). telemetry.get_registry() is the process-wide sink serving
+and training both export through."""
 
+from luminaai_tpu.monitoring.attribution import (
+    OpRow,
+    TraceAttribution,
+    attribute_trace,
+    classify_op,
+    compiled_cost_metrics,
+    export_attribution,
+)
 from luminaai_tpu.monitoring.logger import (
     MetricsCollector,
     TrainingAlert,
@@ -26,4 +36,10 @@ __all__ = [
     "SpanTracer",
     "Span",
     "NULL_TRACER",
+    "OpRow",
+    "TraceAttribution",
+    "attribute_trace",
+    "classify_op",
+    "compiled_cost_metrics",
+    "export_attribution",
 ]

@@ -259,6 +259,10 @@ def make_train_step(
         with mesh:
             return jitted(state, batch)
 
+    # AOT handle for compiled-cost accounting (monitoring/attribution.py):
+    # `call.jitted.lower(state, batch).compile().cost_analysis()` queries
+    # XLA's cost model for THIS executable without executing it.
+    call.jitted = jitted
     return call
 
 

@@ -20,6 +20,7 @@ tracking, OOM fallback) and training_loop.py. TPU-shape differences:
 from __future__ import annotations
 
 import logging
+import os
 import time
 from typing import Any, Callable, Dict, Iterable, Iterator, Optional
 
@@ -792,6 +793,8 @@ class Trainer:
                     # the first tokens_per_sec isn't dominated by compile.
                     float(metrics["loss"])
                     self._count_recompile("initial_compile")
+                    if cfg.compiled_cost_analysis:
+                        self._export_compiled_costs(batch)
                     window_t0, window_tokens, window_steps = time.time(), 0, 0
 
                 if self.global_step % log_every == 0:
@@ -902,15 +905,19 @@ class Trainer:
     # -- profiling (SURVEY §5 tracing) -------------------------------------
     def _maybe_profile(self) -> None:
         """Start/stop a jax.profiler device trace around the configured
-        step window (config.profile_start_step / profile_num_steps)."""
+        step window (config.profile_start_step / profile_num_steps, CLI
+        `--profile-steps N --profile-dir DIR`). When the window closes,
+        the trace is attributed per subsystem (monitoring/attribution.py)
+        into registry gauges + <trace_dir>/attribution.jsonl."""
         cfg = self.config
         if not cfg.profile_start_step:
             return
         if self.global_step == cfg.profile_start_step:
-            trace_dir = f"{cfg.output_dir}/profile"
+            trace_dir = cfg.profile_dir or f"{cfg.output_dir}/profile"
             try:
                 jax.profiler.start_trace(trace_dir)
                 self._profiling = True
+                self._profile_trace_dir = trace_dir
                 logger.info("profiler trace started -> %s", trace_dir)
             except Exception as e:  # already tracing / unsupported backend
                 logger.warning("profiler start failed: %s", e)
@@ -923,6 +930,85 @@ class Trainer:
             jax.profiler.stop_trace()
             self._profiling = False
             logger.info("profiler trace stopped")
+            self._attribute_profile(
+                getattr(self, "_profile_trace_dir", None)
+                or f"{cfg.output_dir}/profile"
+            )
+
+    def _attribute_profile(self, trace_dir: str) -> None:
+        """Per-subsystem breakdown of the just-captured window. Requires
+        the xprof converter; failure costs a warning, never the run."""
+        from luminaai_tpu.monitoring.attribution import (
+            attribute_xplane_dir,
+            export_attribution,
+        )
+
+        try:
+            attr = attribute_xplane_dir(
+                trace_dir, n_steps=max(1, self.config.profile_num_steps)
+            )
+            record = export_attribution(
+                attr,
+                registry=self.registry,
+                jsonl_path=os.path.join(trace_dir, "attribution.jsonl"),
+            )
+            top = list(attr.ms_per_step.items())[:3]
+            logger.info(
+                "step attribution (%d steps, %.1f ms/step attributed): %s "
+                "-> %s/attribution.jsonl",
+                attr.n_steps,
+                attr.total_ms_per_step,
+                ", ".join(f"{k}={v:.1f}ms" for k, v in top),
+                trace_dir,
+            )
+            self._last_attribution = record
+        except Exception as e:
+            logger.warning("trace attribution unavailable: %s", e)
+
+    def _export_compiled_costs(self, batch) -> None:
+        """AOT cost/memory analysis of the just-compiled train step
+        (config.compiled_cost_analysis): exports compiled_flops_per_step,
+        bytes-accessed and HBM-footprint gauges plus the analytic-vs-
+        compiled MFU cross-check. Graceful on backends with no cost
+        model; never raises into the train loop."""
+        from luminaai_tpu.monitoring.attribution import (
+            analytic_train_flops,
+            compiled_cost_metrics,
+        )
+
+        try:
+            tokens_per_step = int(batch["input_ids"].size)
+            result = compiled_cost_metrics(
+                self.train_step,
+                self.state,
+                batch,
+                program="train",
+                registry=self.registry,
+                analytic_flops=analytic_train_flops(
+                    self.config.estimate_active_parameters(), tokens_per_step
+                ),
+            )
+            self._compiled_costs = result
+            if result.get("available"):
+                xc = result.get("mfu_crosscheck") or {}
+                if xc.get("flagged"):
+                    logger.warning(
+                        "analytic-vs-compiled FLOPs diverge %.1f%% "
+                        "(analytic 6NT %.3e, compiled %.3e): the MFU "
+                        "headline and the compiled program disagree",
+                        100 * xc["divergence"],
+                        xc["analytic_flops_per_step"],
+                        xc["compiled_flops_per_step"],
+                    )
+                else:
+                    logger.info("compiled cost analysis: %s", result)
+            else:
+                logger.info(
+                    "compiled cost analysis unavailable: %s",
+                    result.get("reason"),
+                )
+        except Exception as e:  # pragma: no cover - belt and braces
+            logger.warning("compiled cost analysis failed: %s", e)
 
     # -- failure handling --------------------------------------------------
     def _handle_nonfinite(self) -> bool:

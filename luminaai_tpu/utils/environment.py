@@ -330,6 +330,161 @@ def tpu_runtime_diagnostics(probe_timeout: int = 90) -> Dict[str, Any]:
     return out
 
 
+def connectivity_probe(
+    payload_mb: float = 4.0, iters: int = 5, registry=None
+) -> Dict[str, Any]:
+    """ICI/DCN connectivity probe for `cli diagnose` (the role of the
+    reference's scripts/net.sh bandwidth/reachability check, TPU-side).
+
+    Two findings an operator needs before debugging a slow or wedged
+    multi-host job:
+
+      - **per-host device visibility**: every process must see the same
+        global device count and `process_count * local_device_count`
+        must cover it — a host whose NICs came up without its ICI links
+        shows up here, before a collective hangs;
+      - **a small timed all-reduce per mesh axis**: `ici` (devices within
+        this host's slice) and, when multiple processes exist, `dcn`
+        (across hosts). A healthy axis completes in milliseconds;
+        an axis that is orders of magnitude off its peers localizes the
+        sick interconnect tier.
+
+    Results are returned AND exported as `diagnose_*` gauges into the
+    unified registry so a scraped `/metrics` carries the last probe.
+    CPU-safe: on a single-host CPU backend the mesh degenerates to one
+    `ici` axis of size (1..n_local) and the psum still executes — the
+    numbers then validate the probe machinery, not an interconnect.
+
+    Call only after backend reachability is established (cli diagnose's
+    subprocess probe): initializing a dead TPU backend in-process hangs.
+    """
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from luminaai_tpu.monitoring.telemetry import get_registry
+
+    registry = registry or get_registry()
+    n_proc = jax.process_count()
+    n_local = jax.local_device_count()
+    n_global = jax.device_count()
+    visibility: Dict[str, Any] = {
+        "process_count": n_proc,
+        "process_index": jax.process_index(),
+        "local_device_count": n_local,
+        "global_device_count": n_global,
+        "platform": jax.devices()[0].platform,
+        "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
+        # Every device must belong to exactly one process and the global
+        # view must tile evenly across hosts; False here means a host
+        # joined the job blind to part of the slice.
+        "visibility_ok": n_global == n_proc * n_local,
+    }
+    out: Dict[str, Any] = {"visibility": visibility, "allreduce": {}}
+    _export_visibility_gauges(registry, visibility)
+
+    if n_global % n_proc != 0:
+        # A degraded slice (a host lost part of its devices) cannot form
+        # the dcn×ici grid — and is exactly what this probe exists to
+        # surface. The visibility report above already says which host
+        # count is wrong; skip the all-reduce instead of crashing past
+        # the evidence.
+        out["allreduce"]["skipped"] = (
+            f"device grid is ragged ({n_global} devices across {n_proc} "
+            "processes): cannot build a dcn×ici mesh — see visibility"
+        )
+        return out
+
+    # dcn × ici factorization of the device grid: hosts on the slow axis,
+    # local chips on the fast one. Single-host runs probe ici only.
+    devices = np.array(jax.devices()).reshape(n_proc, n_global // n_proc)
+    mesh = Mesh(devices, ("dcn", "ici"))
+    n_elems = max(1, int(payload_mb * 1e6 / 4))  # fp32 words
+
+    for axis in ("ici", "dcn") if n_proc > 1 else ("ici",):
+        axis_size = mesh.shape[axis]
+
+        @jax.jit
+        def _allreduce(x, axis=axis):
+            return shard_map(
+                lambda v: jax.lax.psum(v, axis),
+                mesh=mesh,
+                in_specs=PartitionSpec(axis),
+                out_specs=PartitionSpec(),
+            )(x)
+
+        # Pad the payload up to a multiple of the axis size so the
+        # leading dim shards evenly on odd device counts.
+        length = -(-n_elems // axis_size) * axis_size
+        x = jax.device_put(
+            jnp.ones((length,), jnp.float32),
+            NamedSharding(mesh, PartitionSpec(axis)),
+        )
+        try:
+            _allreduce(x).block_until_ready()  # compile
+            t0 = _time.perf_counter()
+            for _ in range(iters):
+                y = _allreduce(x)
+            y.block_until_ready()
+            dt = (_time.perf_counter() - t0) / iters
+        except Exception as e:  # probe must never wedge diagnose
+            out["allreduce"][axis] = {
+                "size": axis_size, "error": f"{type(e).__name__}: {e}"
+            }
+            continue
+        payload_bytes = x.size * 4
+        out["allreduce"][axis] = {
+            "size": axis_size,
+            "payload_mb": round(payload_bytes / 1e6, 2),
+            "mean_seconds": round(dt, 6),
+            # Algorithmic bandwidth: bytes reduced per second. A size-1
+            # axis reports it for completeness, but it measures copy
+            # speed, not an interconnect.
+            "algo_gbps": round(payload_bytes / max(dt, 1e-9) / 1e9, 3),
+        }
+
+    ar_s = registry.gauge(
+        "diagnose_allreduce_seconds",
+        "Mean timed all-reduce per mesh axis at last diagnose",
+        labelnames=("axis",),
+    )
+    ar_bw = registry.gauge(
+        "diagnose_allreduce_gbps",
+        "Algorithmic all-reduce bandwidth per mesh axis at last diagnose",
+        labelnames=("axis",),
+    )
+    for axis, rec in out["allreduce"].items():
+        if isinstance(rec, dict) and "mean_seconds" in rec:
+            ar_s.labels(axis=axis).set(rec["mean_seconds"])
+            ar_bw.labels(axis=axis).set(rec["algo_gbps"])
+    return out
+
+
+def _export_visibility_gauges(registry, visibility: Dict[str, Any]) -> None:
+    """diagnose_* visibility gauges — exported BEFORE any mesh math so a
+    degraded slice (the case the probe exists for) still reports. Names
+    avoid the _count suffix: the registry reserves histogram exposition
+    suffixes _bucket/_sum/_count for histogram families."""
+    g = registry.gauge
+    g("diagnose_processes", "Hosts in the job at last diagnose").set(
+        visibility["process_count"]
+    )
+    g(
+        "diagnose_local_devices", "Devices visible to this process"
+    ).set(visibility["local_device_count"])
+    g(
+        "diagnose_global_devices", "Global devices at last diagnose"
+    ).set(visibility["global_device_count"])
+    g(
+        "diagnose_device_visibility_ok",
+        "1 when global devices == process_count * local devices",
+    ).set(1.0 if visibility["visibility_ok"] else 0.0)
+
+
 def format_diagnostics(include_accelerator: bool = True) -> str:
     """Human-readable diagnostics block (ref Main.py:619
     print_system_diagnostics).

@@ -9,79 +9,55 @@ Usage:
 n_steps = how many steps the trace window covered (profile_flagship
 captures 3). Requires the xprof package (baked into the image); the
 conversion runs on CPU — no TPU needed to analyze a saved trace.
+
+The classifier and aggregation live in
+luminaai_tpu/monitoring/attribution.py (tested API; the trainer's
+--profile-steps windowed capture uses the same code path) — this script
+is just the offline CLI. It also appends the breakdown to
+<outdir>/attribution.jsonl so repeated analyses build a trend log.
 """
-import collections
-import glob
-import json
 import os
-import re
 import sys
 
-
-def classify(fw_name: str, category: str, source: str) -> str:
-    if "attention" in fw_name and "pallas_call" in fw_name:
-        return "attn_flash_kernels"
-    if "bch,vh->bcv" in fw_name or "fused.py" in source:
-        return "ce_loss"
-    if re.search(r"egch,ehf|egcf,efh|gmm", fw_name):
-        return "moe_expert_matmul"
-    if "/moe/" in fw_name:
-        return "moe_route_dispatch"
-    if "attention/" in fw_name or "qkv" in fw_name:
-        return "attn_proj_rope"
-    if category == "data formatting":
-        return "data_formatting"
-    if not fw_name.strip():
-        return "unattributed(optimizer+dispatch_bwd)"
-    return "other"
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
 
 
 def main() -> None:
+    from luminaai_tpu.monitoring.attribution import (
+        attribute_xplane_dir,
+        export_attribution,
+    )
+    from luminaai_tpu.monitoring.telemetry import MetricsRegistry
+
     outdir = sys.argv[1] if len(sys.argv) > 1 else "profiles/flagship"
     n_steps = int(sys.argv[2]) if len(sys.argv) > 2 else 3
 
-    paths = glob.glob(
-        os.path.join(outdir, "plugins/profile/*/*.xplane.pb")
+    try:
+        attr = attribute_xplane_dir(outdir, n_steps)
+    except RuntimeError as e:
+        sys.exit(str(e))
+    export_attribution(
+        attr,
+        registry=MetricsRegistry(),  # offline: don't pollute the process sink
+        jsonl_path=os.path.join(outdir, "attribution.jsonl"),
     )
-    if not paths:
-        sys.exit(f"no xplane.pb under {outdir}/plugins/profile/*/")
 
-    from xprof.convert import raw_to_tool_data as rtd
-
-    data, _ = rtd.xspace_to_tool_data(paths, "hlo_stats", {})
-    table = json.loads(data)
-    cols = [c["label"] for c in table["cols"]]
-    idx = {c: i for i, c in enumerate(cols)}
-    rows = [[c.get("v") for c in r["c"]] for r in table["rows"]]
-
-    groups = collections.Counter()
-    bound = collections.defaultdict(collections.Counter)
-    for r in rows:
-        t = r[idx["Total self time (us)"]] or 0.0
-        fw = r[idx["Framework op name"]] or ""
-        src = re.sub(r"<[^>]+>", "", r[idx["Source Info"]] or "")
-        g = classify(fw, r[idx["HLO op category"]], src)
-        groups[g] += t
-        bound[g][r[idx["Bound by"]] or "?"] += t
-
-    total = sum(groups.values())
     print(f"{'subsystem':38s} {'ms/step':>9s} {'%':>6s}  dominant bound")
-    for g, t in groups.most_common():
-        dom = bound[g].most_common(1)[0][0]
+    for g, ms in attr.ms_per_step.items():
         print(
-            f"{g:38s} {t / n_steps / 1e3:9.2f} {100 * t / total:5.1f}%  {dom}"
+            f"{g:38s} {ms:9.2f} {100 * attr.fraction[g]:5.1f}%  "
+            f"{attr.dominant_bound[g]}"
         )
-    print(f"{'TOTAL':38s} {total / n_steps / 1e3:9.2f}")
+    print(f"{'TOTAL':38s} {attr.total_ms_per_step:9.2f}")
 
     # Top individual ops — where to look next.
     print("\nTop 10 ops by self time:")
-    rows.sort(key=lambda r: -(r[idx["Total self time (us)"]] or 0))
-    for r in rows[:10]:
-        t = (r[idx["Total self time (us)"]] or 0) / n_steps / 1e3
-        fw = (r[idx["Framework op name"]] or "")[-70:]
+    for op in attr.top_ops:
         print(
-            f"{t:8.2f} ms/step {r[idx['HLO op category']][:18]:18s} "
-            f"{r[idx['Bound by']] or '?':8s} {fw}"
+            f"{op['ms_per_step']:8.2f} ms/step {op['category'][:18]:18s} "
+            f"{op['bound']:8s} {op['fw_name'][-70:]}"
         )
 
 

@@ -1,0 +1,211 @@
+#!/usr/bin/env python
+"""Re-derive scripts/last_good_bench.json from the r3 sweep log.
+
+VERDICT r5 found the cache file's provenance had been hand-edited
+(`captured_at` moved forward ~18h, the `source` field deleted, values
+reformatted to mimic a live bench.py capture). The honest artifact is
+now REPRODUCIBLE instead of hand-maintained: this script parses the
+measured line out of the sweep transcript (scripts/sweep_out2.txt by
+default), recomputes every derived quantity (params, MFU, tok/s ratio)
+from the actual bench config, stamps the capture time recorded in the
+log header, and writes the cache entry with a `source` block carrying
+the log path, line number, the line's sha256, and a payload hash over
+all measurement fields. bench.py refuses to present any cache entry
+whose hashes don't hold (see bench._validate_source), and
+tests/test_attribution.py pins this derivation byte-for-byte — so the
+r5-style silent edit now fails tests AND load-time validation.
+
+Usage:
+    python scripts/rederive_last_good.py [--log scripts/sweep_out2.txt]
+        [--variant attn] [--out scripts/last_good_bench.json] [--check]
+
+--check verifies the existing file matches the derivation (exit 1 on
+drift) instead of writing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import calendar
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+sys.path.insert(0, _ROOT)
+
+# The derivation only does config arithmetic — never let importing the
+# bench config machinery try to initialize a (possibly dead) TPU backend.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# perf_sweep.py's print format, anchored field-for-field.
+_LINE_RE = re.compile(
+    r"^(?P<variant>\S+)\s+step\s+(?P<step_ms>[\d.]+) ms\s+"
+    r"(?P<tps>[\d.]+) tok/s compile\s+(?P<compile_s>[\d.]+)s "
+    r"loss (?P<loss>[\d.]+)\s*$"
+)
+_SESSION_RE = re.compile(
+    r"session_end:\s*(\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2})Z"
+)
+
+# The sweep's 'attn' variant (save_attn remat + gather dispatch) has the
+# same model dims as every flagship variant, so parameter counts and the
+# MFU denominator come from the flagship config itself.
+_VARIANT_CONFIG = "flagship_tuned"
+
+
+def _git_last_commit_for(path: str) -> str | None:
+    try:
+        proc = subprocess.run(
+            ["git", "log", "-n", "1", "--format=%H", "--", path],
+            capture_output=True, text=True, timeout=10, cwd=_ROOT,
+        )
+        return proc.stdout.strip() or None if proc.returncode == 0 else None
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+
+
+def derive(log_path: str, variant: str = "attn") -> dict:
+    """Build the cache payload from the sweep log. Deterministic for a
+    given log file + repo state (no wall-clock anywhere)."""
+    import bench  # repo-root bench harness: config + hash canon
+
+    with open(log_path) as f:
+        lines = f.read().splitlines()
+
+    captured_at = captured_unix = None
+    hit = hit_no = None
+    for i, raw in enumerate(lines, start=1):
+        m = _SESSION_RE.search(raw)
+        if m and captured_at is None:
+            captured_at = m.group(1) + "Z"
+            captured_unix = calendar.timegm(
+                time.strptime(m.group(1), "%Y-%m-%dT%H:%M:%S")
+            )
+        if raw.startswith("#"):
+            continue
+        lm = _LINE_RE.match(raw)
+        if lm and lm.group("variant") == variant:
+            hit, hit_no = lm, i
+    if hit is None:
+        raise SystemExit(
+            f"no '{variant}' measurement line in {log_path} "
+            f"(expected perf_sweep.py output format)"
+        )
+    if captured_at is None:
+        raise SystemExit(f"no 'session_end:' header in {log_path}")
+
+    step_ms = float(hit.group("step_ms"))
+    tps = float(hit.group("tps"))
+    cfg = bench._child_config(_VARIANT_CONFIG, 1)
+    tokens_per_step = cfg.batch_size * cfg.seq_length
+    active = cfg.estimate_active_parameters()
+    flops_per_sec = 6.0 * active * tokens_per_step / (step_ms / 1e3)
+    rel_log = os.path.relpath(os.path.abspath(log_path), _ROOT)
+
+    payload = {
+        "metric": bench.METRIC,
+        "value": round(tps, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(tps / bench.REF_MOE_TOKENS_PER_SEC, 3),
+        "extras": {
+            "chips": 1,
+            "platform": "tpu",
+            "config": _VARIANT_CONFIG,
+            "total_params_m": round(cfg.estimate_parameters() / 1e6, 1),
+            "active_params_m": round(active / 1e6, 1),
+            "batch": cfg.batch_size,
+            "seq": cfg.seq_length,
+            "mfu": round(flops_per_sec / bench.TPU_PEAK_FLOPS, 4),
+            "model_tflops_per_sec": round(flops_per_sec / 1e12, 2),
+            "loss": round(float(hit.group("loss")), 4),
+            "step_ms": step_ms,
+            "compile_s": float(hit.group("compile_s")),
+        },
+        "captured_at": captured_at,
+        "captured_at_unix": captured_unix,
+    }
+    payload["source"] = {
+        "kind": "sweep_log",
+        "path": rel_log,
+        "line": hit_no,
+        "line_sha256": __import__("hashlib").sha256(
+            lines[hit_no - 1].encode()
+        ).hexdigest(),
+        "variant": variant,
+        "git_commit": _git_last_commit_for(rel_log),
+        "note": (
+            "r3 on-chip session measurement (perf_sweep.py 'attn' "
+            "variant: save_attn remat + gather dispatch + 1024 flash "
+            "blocks), seeded into this cache because the session's own "
+            "bench.py attempt hit the tunnel outage. The cited log is a "
+            "restored transcript — see its header for the "
+            "reconstruction provenance. vs_baseline compares the 757M "
+            "flagship against the reference's ~4M-param debug-MoE 59.5k "
+            "tok/s figure (apples-to-oranges on model scale, "
+            "conservative); the matched-dims ref_debug_moe rung replaces "
+            "this entry the next time bench.py completes on chip."
+        ),
+        "payload_sha256": bench._payload_sha256(payload),
+    }
+    return payload
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--log", default=os.path.join(_HERE, "sweep_out2.txt")
+    )
+    ap.add_argument("--variant", default="attn")
+    ap.add_argument(
+        "--out", default=os.path.join(_HERE, "last_good_bench.json")
+    )
+    ap.add_argument(
+        "--check", action="store_true",
+        help="verify --out already matches the derivation; write nothing",
+    )
+    args = ap.parse_args(argv)
+
+    payload = derive(args.log, args.variant)
+    rendered = json.dumps(payload, indent=2) + "\n"
+    if args.check:
+        try:
+            with open(args.out) as f:
+                current = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"DRIFT: cannot read {args.out}: {e}")
+            return 1
+        # git_commit records WHEN the file was derived relative to repo
+        # history (it is outside payload_sha256), so it legitimately
+        # differs between a pre-commit derivation and a post-commit
+        # --check — normalize it out of the comparison.
+        want = json.loads(rendered)
+        for d in (current, want):
+            if isinstance(d.get("source"), dict):
+                d["source"]["git_commit"] = None
+        if current != want:
+            print(
+                f"DRIFT: {args.out} does not match the derivation from "
+                f"{args.log}; run scripts/rederive_last_good.py to restore"
+            )
+            return 1
+        print(f"ok: {args.out} matches {args.log}")
+        return 0
+    tmp = args.out + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(rendered)
+    os.replace(tmp, args.out)
+    print(
+        f"wrote {args.out}: {payload['value']} tok/s "
+        f"captured {payload['captured_at']} "
+        f"(source {payload['source']['path']}:{payload['source']['line']})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
