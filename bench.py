@@ -434,13 +434,23 @@ def _child_main(name: str) -> None:
         ex["decode_compiled_cost"] = _smoke_decode_cost(
             cfg, model, state.params, registry
         )
+        # Resilience surface (docs/resilience.md): a preempt-and-resume
+        # cycle must report exact data-state resume; a False here fails
+        # the smoke artifact loudly (error field + exit 1).
+        resume_check = _smoke_resume_check()
+        ex["resumed_exact_data_state"] = resume_check.pop(
+            "resumed_exact_data_state"
+        )
+        ex["resume_check"] = resume_check
         ex["bench_gate"] = _gate_verdict(result)
         ex["note"] = (
-            "hermetic cpu smoke: attribution + gate surface check, "
-            "not a performance claim"
+            "hermetic cpu smoke: attribution + gate + resume surface "
+            "check, not a performance claim"
         )
         # Snapshot again so the decode-cost gauges land in the artifact.
         ex["telemetry"] = registry.snapshot()
+        if ex["resumed_exact_data_state"] is not True:
+            result["error"] = "resumed_exact_data_state_false"
     if name == "ref_debug_moe":
         result["extras"]["note"] = (
             "reference's own headline benchmark config (debug preset dims, "
@@ -451,6 +461,10 @@ def _child_main(name: str) -> None:
         # smoke keeps its own note: CPU is its design, not a fallback.
         result["extras"]["note"] = "tpu_unavailable_cpu_fallback"
     print(json.dumps(result))
+    if name == "smoke" and "error" in result:
+        # The smoke artifact is an ASSERTION surface (resume contract,
+        # telemetry): fail loudly like --smoke-serve does.
+        sys.exit(1)
 
 
 def _pctl(xs, p):
@@ -955,6 +969,81 @@ def _gate_verdict(result: dict) -> dict:
         return mod.gate(result, mod.load_trajectory(_HERE))
     except Exception as e:
         return {"verdict": "error", "reason": f"{type(e).__name__}: {e}"}
+
+
+def _smoke_resume_check() -> dict:
+    """Preempt-and-resume cycle on a tiny CPU trainer (--smoke only):
+    train, inject a preemption at step 3 (blocking emergency save + data
+    cursor), resume in a FRESH trainer, finish. The artifact must report
+    resumed_exact_data_state: true — the exact-resume contract
+    (docs/resilience.md) exercised on every smoke run, no hardware
+    needed. Self-contained and non-fatal to the measurement (the caller
+    flags the artifact when the check fails)."""
+    tmp = None
+    try:
+        import tempfile
+
+        import numpy as np
+
+        from luminaai_tpu.config import Config
+        from luminaai_tpu.data.dataset import PrefetchLoader
+        from luminaai_tpu.testing.faults import preempt_at_step
+        from luminaai_tpu.training.trainer import Trainer
+
+        tmp = tempfile.mkdtemp(prefix="bench_smoke_resume_")
+
+        def cfg(max_steps):
+            return Config(
+                vocab_size=128, hidden_size=32, num_layers=1, num_heads=2,
+                num_kv_heads=1, seq_length=32, batch_size=4,
+                use_moe=False, use_flash_attention=False,
+                gradient_checkpointing=False, precision="fp32",
+                max_steps=max_steps, eval_every_n_batches=10**6,
+                save_every_n_batches=10**6, health_check_interval=1000,
+                output_dir=tmp, learning_rate=1e-3,
+            )
+
+        def loader():
+            def gen(epoch=0):
+                rng = np.random.RandomState(epoch)
+                for _ in range(50):
+                    yield {
+                        "input_ids": rng.randint(
+                            1, 100, size=(4, 32)
+                        ).astype(np.int32)
+                    }
+
+            return PrefetchLoader(gen, prefetch=2)
+
+        ckpt = tmp + "/ckpt"
+        t1 = Trainer(cfg(6), train_data=loader(), checkpoint_dir=ckpt)
+        with preempt_at_step(t1, 3):
+            s1 = t1.train()
+        t1.close()
+        t2 = Trainer(cfg(6), train_data=loader(), checkpoint_dir=ckpt)
+        resumed_at = t2.global_step
+        s2 = t2.train()
+        t2.close()
+        return {
+            "resumed_exact_data_state": bool(
+                s1.get("preempted")
+                and resumed_at == s1.get("final_step")
+                and s2.get("resumed_exact_data_state")
+            ),
+            "preempted_at": s1.get("final_step"),
+            "resumed_at": resumed_at,
+            "final_step": s2.get("final_step"),
+        }
+    except Exception as e:  # the artifact must stay parseable
+        return {
+            "resumed_exact_data_state": False,
+            "reason": f"{type(e).__name__}: {e}",
+        }
+    finally:
+        if tmp:
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)
 
 
 def _smoke_decode_cost(cfg, model, params, registry) -> dict:

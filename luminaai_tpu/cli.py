@@ -28,6 +28,12 @@ import numpy as np
 
 logger = logging.getLogger(__name__)
 
+# Exit code for "stopped on a preemption signal with a resumable
+# checkpoint banked" — EX_TEMPFAIL by convention, distinct from both
+# success (0) and failure (1/2) so orchestrators can reschedule with
+# `resume` instead of alerting (docs/resilience.md).
+RESUMABLE_EXIT = 75
+
 
 # ---------------------------------------------------------------------------
 # config assembly
@@ -103,17 +109,20 @@ def build_config(args):
 # ---------------------------------------------------------------------------
 def _synthetic_batches(cfg, n_batches: int = 200, seed: int = 0):
     """Learnable repeating-pattern batches (smoke training, ref debug
-    runs on synthetic data)."""
+    runs on synthetic data). Deterministic per (seed, epoch) and wrapped
+    in a PrefetchLoader, so even synthetic runs get the exact-resume
+    contract (docs/resilience.md)."""
+    from luminaai_tpu.data.dataset import PrefetchLoader
 
-    def gen() -> Iterator[Dict[str, np.ndarray]]:
-        rng = np.random.RandomState(seed)
+    def gen(epoch: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+        rng = np.random.RandomState(seed + epoch)
         period = min(64, cfg.vocab_size - 2)
         for _ in range(n_batches):
             starts = rng.randint(0, 32, size=(cfg.batch_size, 1))
             seq = (starts + np.arange(cfg.seq_length)) % period + 1
             yield {"input_ids": seq.astype(np.int32)}
 
-    return gen
+    return PrefetchLoader(gen, prefetch=2)
 
 
 def make_data(cfg, args):
@@ -196,15 +205,13 @@ def make_data(cfg, args):
     if not ds.streaming:
         tokens = sum(int(s["loss_mask"].size) for s in ds.samples)
 
-    epoch_counter = {"n": 0}
-
-    def train_fn():
-        # Fresh permutation per epoch (the trainer re-invokes this callable
-        # at each epoch boundary; a constant seed would replay identical
-        # batch order every epoch).
-        epoch_counter["n"] += 1
+    def train_fn(epoch: int):
+        # Fresh permutation per epoch, derived from the epoch NUMBER (not
+        # a process-local counter): the PrefetchLoader passes the epoch
+        # through, so a resumed run replays the same per-epoch shuffles
+        # and the batch stream continues exactly (docs/resilience.md).
         return conversation_batches(
-            ds, cfg.batch_size, seed=cfg.seed + epoch_counter["n"],
+            ds, cfg.batch_size, seed=cfg.seed + epoch,
             process_index=pi, process_count=pc,
         )
 
@@ -320,6 +327,13 @@ def cmd_train(args) -> int:
     out.parent.mkdir(parents=True, exist_ok=True)
     out.write_text(json.dumps(_jsonable(summary), indent=2))
     final = summary.get("final_metrics", {})
+    if summary.get("preempted"):
+        print(
+            f"training PREEMPTED at step {summary.get('final_step')}: "
+            f"emergency checkpoint committed; rerun `resume` to continue "
+            f"(exit {RESUMABLE_EXIT} = resumable)"
+        )
+        return RESUMABLE_EXIT
     print(
         f"training done: steps={summary.get('final_step')} "
         f"final_loss={final.get('loss', float('nan')):.4f} "
@@ -664,6 +678,9 @@ def cmd_serve(args) -> int:
         trace_jsonl=getattr(args, "trace_jsonl", None),
         trace_jax=getattr(args, "trace_jax", False),
         latency_buckets=buckets,
+        request_timeout_s=getattr(args, "request_timeout_s", None),
+        max_queue_depth=getattr(args, "max_queue_depth", 128),
+        drain_grace_s=getattr(args, "drain_grace_s", 30.0),
     )
     return 0
 
@@ -994,18 +1011,36 @@ def _jsonable(obj: Any) -> Any:
 
 
 def _install_signal_handlers(trainer) -> None:
-    """SIGINT/SIGTERM → emergency checkpoint, then exit (ref Main.py:1126
-    setup_signal_handlers)."""
+    """SIGINT/SIGTERM → graceful preemption (ref Main.py:1126
+    setup_signal_handlers, rebuilt for correctness): the FIRST signal only
+    arms `trainer.request_stop()` — the train loop finishes the step in
+    flight, runs a BLOCKING emergency save at the boundary, and cmd_train
+    exits RESUMABLE_EXIT. Saving from inside the handler (the old
+    behavior) raced the dispatched train step and could checkpoint a
+    half-updated state. A SECOND signal escalates: save whatever state
+    exists right now and exit immediately."""
+    seen = {"n": 0}
 
     def handler(sig, frame):  # pragma: no cover - signal-driven
-        print(f"\nsignal {sig}: saving emergency checkpoint...")
+        seen["n"] += 1
+        if seen["n"] == 1:
+            print(
+                f"\nsignal {sig}: stopping at the next step boundary "
+                "(emergency checkpoint + exact data cursor); signal again "
+                "to force an immediate save and exit"
+            )
+            trainer.request_stop(f"signal {sig}")
+            return
+        print(f"\nsignal {sig} (again): immediate emergency save...")
         try:
-            trainer.save_checkpoint(force=True)
-            trainer.close()
+            trainer.checkpoints.emergency_save(
+                trainer.state, trainer.global_step, f"signal {sig} forced",
+                data_state=trainer._data_state(),
+            )
             print("state saved; exiting")
         except Exception as e:
             print(f"emergency save failed: {e}")
-        sys.exit(128 + sig)
+        sys.exit(RESUMABLE_EXIT)
 
     try:
         signal.signal(signal.SIGINT, handler)
@@ -1197,6 +1232,20 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--latency-buckets", dest="latency_buckets",
                     help="comma-separated histogram bucket bounds in "
                          "seconds (default spans 0.5ms..30s)")
+    sv.add_argument("--request-timeout", dest="request_timeout_s",
+                    type=float, default=None,
+                    help="per-request deadline in seconds: overdue lanes "
+                         "are evicted (504 / SSE error). A request's own "
+                         "timeout_s can only shorten it. Default: none")
+    sv.add_argument("--max-queue-depth", dest="max_queue_depth",
+                    type=int, default=128,
+                    help="admission queue cap: beyond it, generation "
+                         "requests get 503 + Retry-After instead of "
+                         "queuing unboundedly (0 disables shedding)")
+    sv.add_argument("--drain-grace", dest="drain_grace_s", type=float,
+                    default=30.0,
+                    help="seconds SIGTERM waits for in-flight generations "
+                         "to finish before shutdown")
     sv.set_defaults(fn=cmd_serve)
 
     b = sub.add_parser("benchmark", help="run the bench harness")

@@ -329,6 +329,12 @@ class PackedDataset:
         self.process_count = process_count
         self.local_batch = batch_size // process_count
         self.difficulty: Optional[float] = None
+        # Exact-resume position: epoch = completed passes, batch_index =
+        # batches yielded in the pass currently underway. load_state_dict
+        # arms a one-shot fast-forward applied by the next __iter__.
+        self._epoch = 0
+        self._batch_index = 0
+        self._resume_skip = 0
 
     def batches_per_epoch(self) -> int:
         per_batch = self.batch_size * self.seq_length
@@ -395,7 +401,56 @@ class PackedDataset:
             for q in range(self.process_count)
         )
 
+    # -- exact-resume state (docs/resilience.md) -------------------------
+    def state_dict(self) -> Dict[str, Any]:
+        """Checkpointable iteration position. Everything that determines
+        the batch stream is here: the shared shuffle seed, the difficulty
+        snapshot (the curriculum filter changes the doc order), and the
+        (epoch, batch_index) cursor. Restoring it and re-iterating yields
+        the exact continuation of the interrupted stream."""
+        return {
+            "kind": "packed",
+            "epoch": self._epoch,
+            "batch_index": self._batch_index,
+            "shuffle_seed": self.shuffle_seed,
+            "difficulty": self.difficulty,
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        """Restore a `state_dict()` position. The next `__iter__` fast-
+        forwards by packing-and-discarding `batch_index` batches — O(k)
+        numpy work, no tokens trained twice or skipped — then streams the
+        remainder of that epoch bitwise-identically."""
+        if state.get("kind", "packed") != "packed":
+            raise ValueError(
+                f"state kind {state.get('kind')!r} is not a PackedDataset "
+                "state"
+            )
+        if "shuffle_seed" in state:
+            self.shuffle_seed = state["shuffle_seed"]
+        if state.get("difficulty") is not None:
+            self.set_difficulty(float(state["difficulty"]))
+        else:
+            self.difficulty = None
+        self._epoch = int(state.get("epoch", 0))
+        self._resume_skip = int(state.get("batch_index", 0))
+        self._batch_index = self._resume_skip
+
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        skip = self._resume_skip
+        self._resume_skip = 0
+        self._batch_index = 0
+        n = 0
+        for b in self._iter_epoch():
+            n += 1
+            if n <= skip:
+                continue  # fast-forward: re-pack, don't re-serve
+            self._batch_index = n
+            yield b
+        self._epoch += 1
+        self._batch_index = 0
+
+    def _iter_epoch(self) -> Iterator[Dict[str, np.ndarray]]:
         # Snapshot once: a mid-epoch set_difficulty otherwise changes the
         # wrap re-walk order after the lockstep cap was computed from the
         # old order — a host whose newly-filtered shard packs zero batches
@@ -512,13 +567,23 @@ class PackedDataset:
 class PrefetchLoader:
     """Background-thread prefetch of host batches (ref FastDataLoader
     prefetch, core/dataset.py:807). Device placement stays with the caller
-    (Trainer._put) so sharding logic lives in one place."""
+    (Trainer._put) so sharding logic lives in one place.
+
+    Exact-resume: `state_dict()/load_state_dict()` checkpoint the epoch
+    cursor (and the source's own state when it has one); after a load,
+    the next iteration replays the stored epoch's iterator and discards
+    the first `batch_index` batches, so a deterministic `batch_fn` —
+    every loader in this repo — continues the interrupted stream with no
+    batch replayed or dropped. `batch_fn` may take an `epoch` argument
+    (per-epoch shuffles stay reproducible across a restart); zero-arg
+    callables keep working.
+    """
 
     _DONE = object()
 
     def __init__(
         self,
-        batch_fn: Callable[[], Iterator[Dict[str, np.ndarray]]],
+        batch_fn: Callable[..., Iterator[Dict[str, np.ndarray]]],
         prefetch: int = 2,
         source: Optional[Any] = None,
     ):
@@ -527,6 +592,21 @@ class PrefetchLoader:
         # The dataset behind batch_fn, when the caller wants curriculum
         # signals (set_difficulty) forwarded through the loader.
         self.source = source
+        self._epoch = 0  # next epoch to hand out
+        self._consuming = 0  # epoch the current/most recent iterator serves
+        self._yielded = 0  # batches yielded to the consumer this epoch
+        self._resume_skip = 0
+        import inspect
+
+        try:
+            sig = inspect.signature(batch_fn)
+            self._epoch_aware = any(
+                p.name == "epoch"
+                or p.kind is inspect.Parameter.VAR_POSITIONAL
+                for p in sig.parameters.values()
+            )
+        except (TypeError, ValueError):  # builtins / C callables
+            self._epoch_aware = False
 
     def set_difficulty(self, difficulty: float) -> bool:
         target = getattr(self.source, "set_difficulty", None)
@@ -535,6 +615,50 @@ class PrefetchLoader:
             return True
         return False
 
+    # -- exact-resume state (docs/resilience.md) -------------------------
+    def state_dict(self) -> Dict[str, Any]:
+        """Loader position + the source's own state (seed/difficulty for
+        PackedDataset). epoch/batch_index count batches YIELDED to the
+        consumer, so a standalone round-trip continues the stream
+        exactly. The trainer still overwrites them with its
+        trained-batch cursor at save time — its device prefetch consumes
+        one batch ahead of what actually entered a step."""
+        state: Dict[str, Any] = {
+            "kind": "prefetch",
+            "epoch": self._consuming,
+            "batch_index": self._yielded,
+        }
+        src_sd = getattr(self.source, "state_dict", None)
+        if callable(src_sd):
+            src = dict(src_sd())
+            # The loader's skip-based fast-forward supersedes the
+            # source's cursor; keep only the stream-determining fields.
+            src.pop("epoch", None)
+            src.pop("batch_index", None)
+            src.pop("kind", None)
+            state["source"] = src
+        return state
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self._epoch = int(state.get("epoch", 0))
+        self._consuming = self._epoch
+        self._resume_skip = int(state.get("batch_index", 0))
+        self._yielded = self._resume_skip
+        src = state.get("source")
+        src_ld = getattr(self.source, "load_state_dict", None)
+        if src and callable(src_ld):
+            src_ld(dict(src))
+
+    def _start_epoch(self) -> Iterator[Dict[str, np.ndarray]]:
+        """One epoch's host iterator; passes the epoch number to batch_fn
+        when it accepts one (per-epoch reshuffles survive a restart)."""
+        epoch = self._epoch
+        self._epoch += 1
+        self._consuming = epoch
+        if self._epoch_aware:
+            return self.batch_fn(epoch)
+        return self.batch_fn()
+
     def __call__(self) -> Iterator[Dict[str, np.ndarray]]:
         return self.__iter__()
 
@@ -542,6 +666,10 @@ class PrefetchLoader:
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         error: List[BaseException] = []
         stop = threading.Event()
+        host_iter = self._start_epoch()
+        skip = self._resume_skip
+        self._resume_skip = 0
+        self._yielded = skip  # position within this epoch's stream
 
         def put(item) -> bool:
             # Bounded put that aborts when the consumer is gone, so an
@@ -557,7 +685,7 @@ class PrefetchLoader:
 
         def worker():
             try:
-                for b in self.batch_fn():
+                for b in host_iter:
                     if not put(b):
                         return
             except BaseException as e:  # pragma: no cover - propagated below
@@ -572,9 +700,18 @@ class PrefetchLoader:
                 item = q.get()
                 if item is self._DONE:
                     break
+                if skip > 0:
+                    # Resume fast-forward: these batches were consumed by
+                    # the interrupted run before its checkpoint landed.
+                    skip -= 1
+                    continue
+                self._yielded += 1
                 yield item
             if error:
                 raise error[0]
+            # Epoch fully consumed: position is the start of the next one.
+            self._consuming = self._epoch
+            self._yielded = 0
         finally:
             stop.set()
             t.join(timeout=5.0)

@@ -63,6 +63,12 @@ logger = logging.getLogger(__name__)
 MAX_BODY_BYTES = 1 << 20  # 1MB request cap (input_validator also re-checks)
 
 
+class RequestTimeout(Exception):
+    """A request's deadline passed before it finished: the scheduler
+    evicted its lane (or refused admission). Blocking submits surface it
+    as HTTP 504; SSE streams get an error frame (docs/resilience.md)."""
+
+
 class MicroBatcher:
     """Collects concurrent generation requests into one batched decode.
 
@@ -81,8 +87,16 @@ class MicroBatcher:
         self.q: "queue.Queue" = queue.Queue()
         self.batches = 0
         self.max_batch_seen = 0
+        self._busy = False  # a batch is being generated right now
         self._worker = threading.Thread(target=self._loop, daemon=True)
         self._worker.start()
+
+    def queue_depth(self) -> int:
+        return self.q.qsize()
+
+    def idle(self) -> bool:
+        """Nothing queued and nothing generating (drain completion)."""
+        return self.q.empty() and not self._busy
 
     def submit(
         self, prompt_tokens: List[int], gen_kwargs: Dict[str, Any]
@@ -112,6 +126,7 @@ class MicroBatcher:
     def _loop(self) -> None:
         while True:
             first = self.q.get()
+            self._busy = True
             batch = [first]
             requeue = []
             deadline = time.time() + self.window
@@ -144,6 +159,7 @@ class MicroBatcher:
                 self.max_batch_seen = max(self.max_batch_seen, len(batch))
                 for item in batch:
                     item[3].set()
+                self._busy = False
 
 
 class _ContinuousRequest:
@@ -151,11 +167,13 @@ class _ContinuousRequest:
     resolved budgets, and the sink its tokens stream into (a Queue for
     SSE streams, an Event + result for blocking submits)."""
 
-    def __init__(self, prompt, max_new, sample_key, seed, stream):
+    def __init__(self, prompt, max_new, sample_key, seed, stream,
+                 deadline=None):
         self.prompt = list(prompt)
         self.max_new = int(max_new)
         self.sample_key = sample_key
         self.seed = seed
+        self.deadline = deadline  # absolute wall time; None = no limit
         self.stream = bool(stream)
         self.sink: "queue.Queue" = queue.Queue() if stream else None
         self.event = None if stream else threading.Event()
@@ -209,8 +227,12 @@ class ContinuousScheduler:
         tracer: Optional[SpanTracer] = None,
         telemetry: bool = True,
         latency_buckets=DEFAULT_LATENCY_BUCKETS,
+        request_timeout_s: Optional[float] = None,
     ):
         self.engine = engine
+        # Default per-request deadline; a request's own timeout_s can only
+        # shorten it. None = no deadline unless the request asks for one.
+        self.request_timeout_s = request_timeout_s
         self.decoder = decoder or engine.make_stepwise(
             num_slots=num_slots,
             page_size=page_size,
@@ -225,6 +247,11 @@ class ContinuousScheduler:
         self.max_batch_seen = 0
         self.requests_served = 0
         self._pending: List[_ContinuousRequest] = []
+        self._busy = False  # a generation cycle is running right now
+        # Submit-to-terminal request count: covers the dequeue→prefill
+        # window where a request is in neither the queue nor a lane.
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
         self._init_telemetry(registry, tracer, telemetry, latency_buckets)
         self._worker = threading.Thread(target=self._loop, daemon=True)
         self._worker.start()
@@ -278,6 +305,11 @@ class ContinuousScheduler:
         self._m_decode_steps = r.counter(
             "serve_decode_steps_total", "Scheduler decode steps executed"
         )
+        self._m_timeouts = r.counter(
+            "serving_requests_timed_out_total",
+            "Requests evicted (or refused admission) because their "
+            "deadline passed before completion",
+        )
         # Callback gauges hold WEAK refs: the process registry outlives
         # any one scheduler, and a strong closure would pin a replaced
         # scheduler's whole KV pool and export its stale state forever.
@@ -315,11 +347,32 @@ class ContinuousScheduler:
     def queue_depth(self) -> int:
         return self.q.qsize() + len(self._pending)
 
+    def idle(self) -> bool:
+        """No request anywhere between submit and its terminal
+        finish/fail (drain completion). Counted submit-to-terminal, so
+        the dequeue→prefill window — where a request is in neither the
+        queue nor a lane — can never make drain() declare completion and
+        shut the server down on top of the request it exists to
+        protect."""
+        with self._inflight_lock:
+            return self._inflight == 0 and not self._busy
+
+    def _track(self, req: _ContinuousRequest) -> _ContinuousRequest:
+        with self._inflight_lock:
+            self._inflight += 1
+        return req
+
+    def _untrack(self) -> None:
+        with self._inflight_lock:
+            self._inflight -= 1
+
     # -- public API --------------------------------------------------------
     def submit(
         self, prompt_tokens: List[int], gen_kwargs: Dict[str, Any]
     ) -> Tuple[List[int], Dict[str, Any]]:
-        req = self._make_request(prompt_tokens, gen_kwargs, stream=False)
+        req = self._track(
+            self._make_request(prompt_tokens, gen_kwargs, stream=False)
+        )
         self.q.put(req)
         req.event.wait()
         if req.error is not None:
@@ -332,7 +385,9 @@ class ContinuousScheduler:
         """Generator with the generate_stream contract: token ints as the
         lane decodes them, then one final stats dict. Closing it flags the
         request cancelled; the worker frees the slot at the next step."""
-        req = self._make_request(prompt_tokens, gen_kwargs, stream=True)
+        req = self._track(
+            self._make_request(prompt_tokens, gen_kwargs, stream=True)
+        )
         self.q.put(req)
 
         def events():
@@ -381,7 +436,7 @@ class ContinuousScheduler:
                 sorted(
                     (k, v)
                     for k, v in gen_kwargs.items()
-                    if k not in ("max_new_tokens", "seed")
+                    if k not in ("max_new_tokens", "seed", "timeout_s")
                 )
             )
         cap = int(
@@ -394,9 +449,13 @@ class ContinuousScheduler:
             # rounded slot size) keeps decode inside the engine's
             # max_context contract.
             max_new = max(1, min(max_new, cap - 1))
+        timeout = gen_kwargs.get("timeout_s") or self.request_timeout_s
+        if timeout and self.request_timeout_s:
+            timeout = min(float(timeout), float(self.request_timeout_s))
         return _ContinuousRequest(
             prompt_tokens, max_new, sample_key,
             gen_kwargs.get("seed"), stream,
+            deadline=(time.time() + float(timeout)) if timeout else None,
         )
 
     def _emit(self, req: _ContinuousRequest, token: int) -> None:
@@ -405,6 +464,8 @@ class ContinuousScheduler:
             req.sink.put(int(token))
 
     def _finish(self, req: _ContinuousRequest, stopped: str) -> None:
+        if req.done:
+            return  # terminal already delivered
         dt = time.time() - req.t0
         n = len(req.tokens)
         stats = {
@@ -420,6 +481,7 @@ class ContinuousScheduler:
         }
         self.requests_served += 1
         req.done = True
+        self._untrack()
         if req.stream:
             req.sink.put(stats)
         else:
@@ -427,12 +489,27 @@ class ContinuousScheduler:
             req.event.set()
 
     def _fail(self, req: _ContinuousRequest, err: BaseException) -> None:
+        if req.done:
+            return  # terminal already delivered
         req.done = True
+        self._untrack()
         if req.stream:
             req.sink.put(err)
         else:
             req.error = err
             req.event.set()
+
+    def _timeout(self, req: _ContinuousRequest, where: str) -> None:
+        """Deadline enforcement: a lane past its deadline stops costing
+        decode steps NOW (eviction frees the slot for queued work) and the
+        client gets an explicit timeout instead of an open-ended wait."""
+        if self.telemetry:
+            self._m_timeouts.inc()
+        waited = time.time() - req.t0
+        self._fail(req, RequestTimeout(
+            f"deadline exceeded after {waited:.1f}s ({where}; "
+            f"{len(req.tokens)} tokens generated)"
+        ))
 
     def _release_slot(self, slot: int) -> None:
         """Single choke point for giving a slot back: the decoder free +
@@ -453,6 +530,11 @@ class ContinuousScheduler:
         the shared decode from the next step."""
         if req.cancelled:
             self._finish(req, "cancelled")
+            return
+        if req.deadline is not None and time.time() > req.deadline:
+            # Expired while queued (slot contention / key parking): refuse
+            # admission rather than spend prefill on a dead request.
+            self._timeout(req, "while queued")
             return
         slot = self.decoder.acquire_slot()
         t_admit = time.perf_counter()
@@ -515,12 +597,15 @@ class ContinuousScheduler:
     def _loop(self) -> None:
         while True:
             req = self._pending.pop(0) if self._pending else self.q.get()
+            self._busy = True
             try:
                 self._run_generation(req)
             except Exception as e:  # never kill the worker
                 logger.exception("continuous scheduler generation failed")
                 if not req.done:  # the client must never hang on a bug
                     self._fail(req, e)
+            finally:
+                self._busy = False
 
     def _run_generation(self, first: _ContinuousRequest) -> None:
         self.batches += 1
@@ -572,9 +657,16 @@ class ContinuousScheduler:
                 # Per-token decode latency: the step IS the inter-token
                 # gap for every lane that emitted this step.
                 self._m_token.observe(step_dt, count=max(0, n_produced))
+            now = time.time()
             for slot, r in list(active.items()):
                 if r.cancelled:
                     self._finish(r, "cancelled")
+                    self._release(r, active)
+                    continue
+                if r.deadline is not None and now > r.deadline:
+                    # Overdue lane (slow/stuck decode or an oversized
+                    # budget): evict so the slot serves queued work.
+                    self._timeout(r, "mid-decode")
                     self._release(r, active)
                     continue
                 if eos[slot]:
@@ -647,11 +739,21 @@ class ChatServer:
         telemetry: bool = True,
         latency_buckets=DEFAULT_LATENCY_BUCKETS,
         warmup: bool = False,
+        request_timeout_s: Optional[float] = None,
+        max_queue_depth: int = 128,
+        drain_grace_s: float = 30.0,
     ):
         self.engine = engine
         self.telemetry = bool(telemetry)
         self.registry = registry or get_registry()
         self.tracer = tracer or NULL_TRACER
+        # Graceful degradation (docs/resilience.md): deadlines evict
+        # overdue lanes, queue-depth overload sheds with 503+Retry-After,
+        # and SIGTERM drains in-flight work before shutdown.
+        self.request_timeout_s = request_timeout_s
+        self.max_queue_depth = max(0, int(max_queue_depth))
+        self.drain_grace_s = float(drain_grace_s)
+        self._draining = False
         # Readiness gate for /healthz: a container probe must see 503
         # while XLA is still compiling the prefill/decode executables
         # (minutes for real models) and flip to 200 the moment requests
@@ -678,6 +780,7 @@ class ChatServer:
                 tracer=self.tracer,
                 telemetry=telemetry,
                 latency_buckets=latency_buckets,
+                request_timeout_s=request_timeout_s,
             )
         else:
             self.batcher = MicroBatcher(
@@ -702,11 +805,23 @@ class ChatServer:
         self._m_tokens_out = r.counter(
             "serve_tokens_out_total", "Generated tokens returned to clients"
         )
+        self._m_overload = r.counter(
+            "serving_overload_rejections_total",
+            "Generation requests shed with 503 + Retry-After because the "
+            "admission queue was at max_queue_depth",
+        )
         r.gauge(
             "serve_ready",
             "1 once the engine is warmed and serving, 0 while compiling",
         ).set_function(
             weak_callback(self, lambda s: float(s._ready.is_set()))
+        )
+        r.gauge(
+            "serve_draining",
+            "1 while the server is draining (admissions stopped, in-flight "
+            "generations finishing before shutdown)",
+        ).set_function(
+            weak_callback(self, lambda s: float(s._draining))
         )
         if warmup:
             threading.Thread(target=self._warmup, daemon=True).start()
@@ -743,6 +858,88 @@ class ChatServer:
     # -- readiness ---------------------------------------------------------
     def mark_ready(self) -> None:
         self._ready.set()
+
+    # -- graceful shutdown (docs/resilience.md) ----------------------------
+    def begin_drain(self) -> None:
+        """Stop admitting generation requests. /healthz stays 200 (the
+        process is healthy) but advertises `draining` in the body and the
+        serve_draining gauge; in-flight lanes keep decoding to completion."""
+        if not self._draining:
+            self._draining = True
+            logger.warning(
+                "drain started: new generations rejected, in-flight work "
+                "finishing (queue_depth=%d)", self._queue_depth(),
+            )
+
+    def _idle(self) -> bool:
+        idle = getattr(self.batcher, "idle", None)
+        return bool(idle()) if callable(idle) else True
+
+    def drain(self, timeout_s: Optional[float] = None) -> bool:
+        """begin_drain + wait (bounded) for in-flight generations to
+        finish. Returns True when the scheduler went idle inside the
+        grace window; False means the deadline expired with lanes still
+        active (the caller shuts down anyway — bounded beats hung)."""
+        self.begin_drain()
+        deadline = time.time() + (
+            self.drain_grace_s if timeout_s is None else float(timeout_s)
+        )
+        while time.time() < deadline:
+            if self._idle():
+                logger.info("drain complete: scheduler idle")
+                return True
+            time.sleep(0.05)
+        idle = self._idle()
+        if not idle:
+            logger.warning(
+                "drain grace expired with work still in flight; "
+                "shutting down anyway"
+            )
+        return idle
+
+    def _queue_depth(self) -> int:
+        qd = getattr(self.batcher, "queue_depth", None)
+        return int(qd()) if callable(qd) else 0
+
+    def _shed(self):
+        """Load-shedding gate for generation endpoints: draining servers
+        and full admission queues answer 503 + Retry-After immediately
+        instead of queuing unboundedly (clients retry against a replica)."""
+        if self._draining:
+            return 503, {
+                "error": "server draining; retry against another replica",
+                "retry_after": 2,
+            }
+        depth = self._queue_depth()
+        if self.max_queue_depth and depth >= self.max_queue_depth:
+            if self.telemetry:
+                self._m_overload.inc()
+            # Rough time-to-queue-space: a slot's worth of queued work.
+            slots = getattr(self.batcher, "max_batch", None) or getattr(
+                getattr(self.batcher, "decoder", None), "num_slots", 8
+            )
+            return 503, {
+                "error": f"overloaded: admission queue at {depth}; "
+                         "retry later",
+                "retry_after": max(1, depth // max(1, int(slots or 8))),
+            }
+        return None
+
+    def _effective_timeout(self, body: Dict[str, Any]) -> Optional[float]:
+        """Per-request deadline: the request's timeout_s can only SHORTEN
+        the server's request_timeout_s cap (a client must not be able to
+        pin a lane past the operator's bound)."""
+        cap = self.request_timeout_s
+        t = body.get("timeout_s")
+        try:
+            t = float(t) if t is not None else None
+        except (TypeError, ValueError):
+            t = None
+        if t is not None and t <= 0:
+            t = None
+        if t is None:
+            return cap
+        return min(t, cap) if cap else t
 
     def _warmup(self) -> None:
         """Compile-priming generation through the real batcher path (the
@@ -804,8 +1001,13 @@ class ChatServer:
                     "status": "warming",
                     "uptime_s": round(time.time() - self.t0, 1),
                 }
+            # Draining stays 200: the process is healthy and finishing
+            # in-flight work — a 5xx here would get it killed mid-drain.
+            # Observers that care read `status` or the serve_draining
+            # gauge (docker-compose.dev.yml's curl healthcheck tolerates
+            # the drain window by construction).
             out = {
-                "status": "ok",
+                "status": "draining" if self._draining else "ok",
                 "uptime_s": round(time.time() - self.t0, 1),
                 **self._scheduler_state(),
             }
@@ -851,6 +1053,9 @@ class ChatServer:
                 return 401, {"error": "authentication failed"}
             return 200, {"token": token}
         if method == "POST" and path in ("/v1/generate", "/v1/chat"):
+            shed = self._shed()  # drain/overload: reject before auth work
+            if shed is not None:
+                return shed
             with self.state_lock:
                 err = self._gate(body, token)
             if err is not None:
@@ -949,7 +1154,15 @@ class ChatServer:
         # Concurrent requests with the same sampling params ride one
         # batched decode (MicroBatcher); sampling overrides go as generate
         # kwargs, so there is no config mutation to serialize.
-        tokens, stats = self.batcher.submit(prompt_ids, overrides)
+        timeout_s = self._effective_timeout(body)
+        if self.continuous and timeout_s:
+            # Deadlines are a continuous-scheduler contract (step-level
+            # eviction); the legacy run-to-completion path can't evict.
+            overrides = {**overrides, "timeout_s": timeout_s}
+        try:
+            tokens, stats = self.batcher.submit(prompt_ids, overrides)
+        except RequestTimeout as e:
+            return 504, {"error": str(e)}
         return self._reply_payload(tokens, stats, reply_key, t0)
 
     def _reply_payload(self, tokens, stats, reply_key, t0, **extra) -> tuple:
@@ -1023,6 +1236,9 @@ class ChatServer:
         decode directly (one stream per request thread) rather than the
         MicroBatcher — each stream owns its decode cadence; batched SSE
         would couple every client's latency to the slowest stream."""
+        shed = self._shed()  # drain/overload applies to streams too
+        if shed is not None:
+            return shed, None
         with self.state_lock:
             err = self._gate(body, token)
         if err is not None:
@@ -1034,6 +1250,9 @@ class ChatServer:
         err, prompt_ids, overrides, reply_key = self._parse_request(path, body)
         if err is not None:
             return err, None
+        timeout_s = self._effective_timeout(body)
+        if self.continuous and timeout_s:
+            overrides = {**overrides, "timeout_s": timeout_s}
         if self.continuous:
             # Streams ride the shared continuous decode loop like any
             # other request — concurrency is bounded by the KV pool's
@@ -1163,6 +1382,12 @@ class ChatServer:
                 data = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
+                if isinstance(payload, dict) and "retry_after" in payload:
+                    # Overload/drain 503s carry the standard header so
+                    # off-the-shelf clients and LBs back off correctly.
+                    self.send_header(
+                        "Retry-After", str(int(payload["retry_after"]))
+                    )
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
@@ -1296,6 +1521,28 @@ class ChatServer:
 
     def serve_forever(self, host: str = "127.0.0.1", port: int = 5001):
         httpd = ThreadingHTTPServer((host, port), self.make_handler())
+
+        def _graceful(sig, frame):  # pragma: no cover - signal-driven
+            logger.warning(
+                "signal %s: draining (grace %.0fs) before shutdown",
+                sig, self.drain_grace_s,
+            )
+
+            def _stop():
+                self.drain()
+                httpd.shutdown()
+
+            # shutdown() must not run on the serve_forever thread (it
+            # joins the poll loop), and a signal handler must return fast.
+            threading.Thread(target=_stop, daemon=True).start()
+
+        import signal as _signal
+
+        try:
+            _signal.signal(_signal.SIGTERM, _graceful)
+            _signal.signal(_signal.SIGINT, _graceful)
+        except ValueError:  # pragma: no cover - non-main thread (tests)
+            pass
         logger.info("serving on http://%s:%d (secure=%s)", host, port,
                     self.secure)
         try:
@@ -1321,6 +1568,9 @@ def serve(
     trace_jsonl: Optional[str] = None,
     trace_jax: bool = False,
     latency_buckets=None,
+    request_timeout_s: Optional[float] = None,
+    max_queue_depth: int = 128,
+    drain_grace_s: float = 30.0,
 ):
     """Build an engine from a checkpoint and serve it (CLI `serve`)."""
     from luminaai_tpu.inference.chat import ChatInterface
@@ -1340,6 +1590,9 @@ def serve(
         admission_window_ms=admission_window_ms,
         telemetry=telemetry,
         tracer=tracer,
+        request_timeout_s=request_timeout_s,
+        max_queue_depth=max_queue_depth,
+        drain_grace_s=drain_grace_s,
         latency_buckets=(
             tuple(latency_buckets)
             if latency_buckets

@@ -1,0 +1,11 @@
+"""Test-support toolkit: fault injectors for the resilience contract
+(docs/resilience.md; driven by tests/test_resilience.py)."""
+
+from luminaai_tpu.testing.faults import (  # noqa: F401
+    corrupt_checkpoint,
+    fail_step_at,
+    preempt_at_step,
+    sigterm_at_step,
+    slow_decode,
+    truncated_checkpoint_writes,
+)

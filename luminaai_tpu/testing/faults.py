@@ -1,0 +1,194 @@
+"""Fault injectors: make failure modes reproducible on a laptop.
+
+The recovery paths (OOM backoff ladder, instability rollback, emergency
+save, restore fallback-walk, serving drain/deadline eviction) are only a
+contract if they can be exercised deliberately; these monkeypatch-style
+injectors do that without touching production code paths. Every injector
+is a context manager that restores what it wrapped — and restores
+NOTHING if the wrapped attribute was legitimately replaced mid-test
+(e.g. the OOM ladder rebuilding `trainer.train_step` is the behavior
+under test, not collateral to undo).
+
+Used by tests/test_resilience.py (pytest marker: `faults`); documented
+in docs/resilience.md.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import signal as _signal
+import time
+from pathlib import Path
+from typing import Callable, Iterator, Optional
+
+logger = logging.getLogger(__name__)
+
+
+def _restore(obj, name, wrapper, original) -> None:
+    """Put `original` back only if our wrapper is still installed — a
+    recovery path that legitimately rebuilt the attribute (the thing
+    under test) must keep its rebuilt version."""
+    if getattr(obj, name, None) is wrapper:
+        setattr(obj, name, original)
+
+
+@contextlib.contextmanager
+def fail_step_at(
+    trainer,
+    step_no: int,
+    exc_factory: Optional[Callable[[], BaseException]] = None,
+    times: int = 1,
+) -> Iterator[dict]:
+    """Make the trainer's `step_no`-th train_step CALL (1-based, counted
+    from entry) raise — default a JaxRuntimeError that reads as a device
+    OOM, so `train_with_oom_protection`'s backoff ladder engages. Raises
+    `times` consecutive calls, then passes through. Yields a stats dict
+    ({'calls', 'raised'})."""
+    if exc_factory is None:
+        import jax
+
+        def exc_factory():
+            return jax.errors.JaxRuntimeError(
+                "RESOURCE_EXHAUSTED: injected fault: Ran out of memory"
+            )
+
+    stats = {"calls": 0, "raised": 0}
+    original = trainer.train_step
+
+    def wrapper(state, batch):
+        stats["calls"] += 1
+        if stats["calls"] >= step_no and stats["raised"] < times:
+            stats["raised"] += 1
+            raise exc_factory()
+        return original(state, batch)
+
+    trainer.train_step = wrapper
+    try:
+        yield stats
+    finally:
+        _restore(trainer, "train_step", wrapper, original)
+
+
+@contextlib.contextmanager
+def preempt_at_step(trainer, step_no: int) -> Iterator[dict]:
+    """Call `trainer.request_stop()` right after the `step_no`-th train
+    step completes — the in-process equivalent of a SIGTERM landing
+    mid-step: the loop must finish the step, run a BLOCKING emergency
+    save at the boundary, and return with summary['preempted']=True."""
+    stats = {"calls": 0}
+    original = trainer.train_step
+
+    def wrapper(state, batch):
+        stats["calls"] += 1
+        out = original(state, batch)
+        if stats["calls"] == step_no:
+            trainer.request_stop("injected preemption")
+        return out
+
+    trainer.train_step = wrapper
+    try:
+        yield stats
+    finally:
+        _restore(trainer, "train_step", wrapper, original)
+
+
+@contextlib.contextmanager
+def sigterm_at_step(trainer, step_no: int) -> Iterator[dict]:
+    """Deliver a REAL SIGTERM to this process right after the
+    `step_no`-th train step — exercises the installed signal handler end
+    to end (cli._install_signal_handlers → request_stop → emergency
+    save → RESUMABLE_EXIT). Only for subprocess-based tests: the default
+    SIGTERM disposition kills the process."""
+    stats = {"calls": 0}
+    original = trainer.train_step
+
+    def wrapper(state, batch):
+        stats["calls"] += 1
+        out = original(state, batch)
+        if stats["calls"] == step_no:
+            os.kill(os.getpid(), _signal.SIGTERM)
+        return out
+
+    trainer.train_step = wrapper
+    try:
+        yield stats
+    finally:
+        _restore(trainer, "train_step", wrapper, original)
+
+
+def corrupt_checkpoint(
+    checkpoint_dir, step: int, mode: str = "truncate"
+) -> int:
+    """Corrupt an on-disk orbax checkpoint the way a kill-mid-commit or
+    disk-full does: `truncate` halves every state file (partial write),
+    `delete` removes them. Returns the number of files damaged; raises if
+    the step directory does not exist (a typo must not silently 'pass')."""
+    step_dir = Path(checkpoint_dir) / str(step)
+    if not step_dir.is_dir():
+        raise FileNotFoundError(f"no checkpoint step dir {step_dir}")
+    state_dir = step_dir / "state"
+    root = state_dir if state_dir.is_dir() else step_dir
+    damaged = 0
+    for f in sorted(root.rglob("*")):
+        if not f.is_file():
+            continue
+        if mode == "delete":
+            f.unlink()
+            damaged += 1
+        else:
+            size = f.stat().st_size
+            if size > 1:
+                with f.open("r+b") as fh:
+                    fh.truncate(max(1, size // 2))
+                damaged += 1
+    if damaged == 0:
+        raise RuntimeError(f"nothing to corrupt under {root}")
+    logger.warning("corrupted %d file(s) in %s (%s)", damaged, root, mode)
+    return damaged
+
+
+@contextlib.contextmanager
+def truncated_checkpoint_writes(manager) -> Iterator[dict]:
+    """Make every save through this CheckpointManager land truncated on
+    disk (the commit 'succeeds' but the bytes are partial) — the failure
+    a restore-side integrity walk must survive. Yields {'saves': n}."""
+    stats = {"saves": 0}
+    original = manager.save
+
+    def wrapper(state, step, *args, **kwargs):
+        ok = original(state, step, *args, **kwargs)
+        manager.wait()  # let the async commit land before damaging it
+        try:
+            corrupt_checkpoint(manager.dir, step)
+            stats["saves"] += 1
+        except (FileNotFoundError, RuntimeError):
+            pass  # save was skipped (duplicate step): nothing written
+        return ok
+
+    manager.save = wrapper
+    try:
+        yield stats
+    finally:
+        _restore(manager, "save", wrapper, original)
+
+
+@contextlib.contextmanager
+def slow_decode(decoder, delay_s: float = 0.2) -> Iterator[dict]:
+    """Slow/stuck-lane injector: every decode_step stalls `delay_s`, so a
+    serving request with a deadline goes overdue mid-decode and the
+    scheduler's eviction path fires. Yields {'steps': n}."""
+    stats = {"steps": 0}
+    original = decoder.decode_step
+
+    def wrapper(*args, **kwargs):
+        stats["steps"] += 1
+        time.sleep(delay_s)
+        return original(*args, **kwargs)
+
+    decoder.decode_step = wrapper
+    try:
+        yield stats
+    finally:
+        _restore(decoder, "decode_step", wrapper, original)

@@ -28,6 +28,7 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from luminaai_tpu.config import Config
+from luminaai_tpu.monitoring.telemetry import MetricsRegistry, get_registry
 
 logger = logging.getLogger(__name__)
 
@@ -46,6 +47,19 @@ def _rng_to_data(rng):
     return jax.random.key_data(rng) if _is_typed_key(rng) else rng
 
 
+def _reason_label(reason: str) -> str:
+    """Collapse freeform emergency-save reasons into a bounded label set
+    (Prometheus label cardinality must not scale with log messages)."""
+    low = (reason or "").lower()
+    if "preempt" in low or "sigterm" in low or "signal" in low:
+        return "preemption"
+    if "finite" in low or "nan" in low:
+        return "non_finite"
+    if "oom" in low or "resource" in low:
+        return "oom"
+    return "other"
+
+
 class CheckpointManager:
     """Save/restore TrainState with rotation, best-k tracking and resume.
 
@@ -53,12 +67,32 @@ class CheckpointManager:
     <dir>/checkpoint_history.json mirrors ref history tracking.
     """
 
-    def __init__(self, config: Config, checkpoint_dir: str = "checkpoints"):
+    def __init__(
+        self,
+        config: Config,
+        checkpoint_dir: str = "checkpoints",
+        registry: Optional[MetricsRegistry] = None,
+    ):
         self.config = config
         self.dir = Path(checkpoint_dir).absolute()
         self.dir.mkdir(parents=True, exist_ok=True)
         self.history_file = self.dir / "checkpoint_history.json"
         self.history: List[Dict[str, Any]] = self._load_history()
+        r = registry or get_registry()
+        # Resilience counters (docs/resilience.md): restore fallbacks are
+        # the "latest checkpoint was corrupt/partial" signal; emergency
+        # saves carry a bounded reason label (preemption / non_finite /
+        # signal / other) so dashboards see WHY runs are bailing.
+        self._m_fallbacks = r.counter(
+            "checkpoint_restore_fallbacks_total",
+            "Corrupt/partial checkpoints skipped while walking back to "
+            "the newest intact one on restore",
+        )
+        self._m_emergency = r.counter(
+            "emergency_saves_total",
+            "Blocking emergency checkpoints, by (bounded) reason",
+            labelnames=("reason",),
+        )
         self.best_loss = min(
             (h["eval_loss"] for h in self.history if h.get("eval_loss") is not None),
             default=float("inf"),
@@ -79,8 +113,14 @@ class CheckpointManager:
         step: int,
         metrics: Optional[Dict[str, float]] = None,
         force: bool = False,
+        data_state: Optional[Dict[str, Any]] = None,
     ) -> bool:
-        """Async-save train state at `step` (ref checkpoint.py:36)."""
+        """Async-save train state at `step` (ref checkpoint.py:36).
+
+        `data_state` is the loader's exact-resume cursor (epoch, batch
+        index, shuffle seed, difficulty — dataset state_dict()); it rides
+        in the JSON metadata so `trainer.maybe_resume` can fast-forward
+        the data stream to the exact batch after this step."""
         metrics = {
             k: float(v)
             for k, v in (metrics or {}).items()
@@ -94,18 +134,19 @@ class CheckpointManager:
             # force: re-save with fresher metrics (e.g. final eval).
             self.wait()
             self._mngr.delete(step)
+        meta: Dict[str, Any] = {
+            "step": step,
+            "config": self.config.to_dict(),
+            "metrics": metrics,
+            "timestamp": time.time(),
+        }
+        if data_state is not None:
+            meta["data_state"] = data_state
         saved = self._mngr.save(
             step,
             args=ocp.args.Composite(
                 state=ocp.args.StandardSave(saveable),
-                metadata=ocp.args.JsonSave(
-                    {
-                        "step": step,
-                        "config": self.config.to_dict(),
-                        "metrics": metrics,
-                        "timestamp": time.time(),
-                    }
-                ),
+                metadata=ocp.args.JsonSave(meta),
             ),
             metrics=metrics,
             force=force,
@@ -150,6 +191,49 @@ class CheckpointManager:
             rng=rng,
         )
 
+    def restore_with_fallback(
+        self,
+        state,
+        step: Optional[int] = None,
+        min_step: int = 0,
+    ):
+        """Restore the newest INTACT checkpoint at or before `step`.
+
+        A preemption or disk-full can leave the latest checkpoint
+        truncated; rather than crash the resume, walk back through older
+        steps until one restores, counting each skip into
+        `checkpoint_restore_fallbacks_total`. Returns
+        (restored_state, used_step, n_skipped); raises the LAST restore
+        error only when every candidate fails."""
+        candidates = [
+            s for s in sorted(self._mngr.all_steps(), reverse=True)
+            if (step is None or s <= step) and s >= min_step
+        ]
+        if not candidates:
+            raise FileNotFoundError(
+                f"no restorable checkpoints under {self.dir} "
+                f"(step<={step}, min_step={min_step})"
+            )
+        last_exc: Optional[BaseException] = None
+        for i, s in enumerate(candidates):
+            try:
+                restored = self.restore(state, s)
+                if i > 0:
+                    logger.warning(
+                        "restored step %d after skipping %d corrupt/partial "
+                        "newer checkpoint(s)", s, i,
+                    )
+                return restored, s, i
+            except Exception as e:
+                last_exc = e
+                self._m_fallbacks.inc()
+                logger.warning(
+                    "checkpoint at step %d failed to restore (%s: %s); "
+                    "falling back to an older step",
+                    s, type(e).__name__, str(e)[:200],
+                )
+        raise last_exc  # every candidate failed
+
     def load_metadata(self, step: Optional[int] = None) -> Dict[str, Any]:
         if step is None:
             step = self.latest_step()
@@ -193,16 +277,40 @@ class CheckpointManager:
         shutil.copytree(self.dir / str(step), dest)
         return str(dest)
 
-    def emergency_save(self, state, step: int, reason: str = "") -> bool:
-        """Best-effort synchronous save on failure (ref checkpoint.py:355)."""
+    def emergency_save(
+        self,
+        state,
+        step: int,
+        reason: str = "",
+        data_state: Optional[Dict[str, Any]] = None,
+    ) -> bool:
+        """Blocking last-chance save (ref checkpoint.py:355).
+
+        The wait_until_finished lives in a `finally`: the caller's next
+        move is usually `sys.exit`, and returning while the async orbax
+        commit is still in flight would let the exit truncate the very
+        checkpoint this exists to protect (contract-tested with an
+        injected exit in tests/test_resilience.py)."""
+        self._m_emergency.labels(reason=_reason_label(reason)).inc()
+        ok = False
         try:
-            ok = self.save(state, step, metrics={"emergency": 1.0}, force=True)
-            self.wait()
-            logger.warning("emergency checkpoint at step %d (%s)", step, reason)
-            return ok
-        except Exception as e:  # pragma: no cover
+            ok = self.save(
+                state, step, metrics={"emergency": 1.0}, force=True,
+                data_state=data_state,
+            )
+        except Exception as e:
             logger.error("emergency save failed: %s", e)
-            return False
+        finally:
+            try:
+                self.wait()  # BLOCK until the commit has fully landed
+            except Exception as e:  # pragma: no cover - flush failure
+                logger.error("emergency save flush failed: %s", e)
+                ok = False
+        if ok:
+            logger.warning(
+                "emergency checkpoint at step %d (%s) committed", step, reason
+            )
+        return ok
 
     # -- history --------------------------------------------------------
     def _load_history(self) -> List[Dict[str, Any]]:

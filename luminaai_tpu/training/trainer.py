@@ -145,13 +145,15 @@ class Trainer:
         )
         self._batch_sharding = NamedSharding(self.mesh, batch_spec())
 
-        ckpt_dir = checkpoint_dir or f"{config.output_dir}/checkpoints"
-        self.checkpoints = CheckpointManager(config, ckpt_dir)
         # Unified telemetry: the same process-wide registry the serving
         # stack exports through /metrics, so training step/throughput/
         # recompile counters and health gauges ride one exposition path.
         self.registry = registry or get_registry()
         self.tracer = tracer or NULL_TRACER
+        ckpt_dir = checkpoint_dir or f"{config.output_dir}/checkpoints"
+        self.checkpoints = CheckpointManager(
+            config, ckpt_dir, registry=self.registry
+        )
         r = self.registry
         self._m_steps = r.counter(
             "train_steps_total", "Optimizer steps executed this process"
@@ -174,6 +176,11 @@ class Trainer:
         )
         self._m_tps = r.gauge(
             "train_tokens_per_sec", "Throughput over the last log window"
+        )
+        self._m_preemptions = r.counter(
+            "preemptions_total",
+            "Stop requests (SIGTERM/SIGINT preemption) honored at a step "
+            "boundary with a blocking emergency save",
         )
         self.monitor = TrainingHealthMonitor(
             log_dir=f"{config.output_dir}/logs",
@@ -211,6 +218,17 @@ class Trainer:
         # evolution changed the param tree) and must never be restored.
         self._min_restorable_step = 0
         self._interventions: list = []
+        # Exact-resume data cursor: counted HERE (per trained batch), not
+        # in the loader — prefetch runs ahead of training, so only the
+        # consumer knows which batches actually entered a step.
+        self._data_epoch = 0
+        self._batch_in_epoch = 0
+        self._resumed_exact_data_state = False
+        # Preemption: request_stop() arms a stop at the next step
+        # boundary; the loop then runs a BLOCKING emergency save and
+        # returns with summary["preempted"]=True (docs/resilience.md).
+        self._stop_requested: Optional[str] = None
+        self._preempted = False
         # Orchestrator hook: called with (step, scalar_metrics) at log
         # cadence; may call adjust_learning_rate/rollback/evolve_experts.
         self.step_callback: Optional[Callable[[int, Dict[str, float]], None]] = None
@@ -223,33 +241,128 @@ class Trainer:
         step = self.checkpoints.get_resume_step()
         if step is None:
             return False
+        # Architecture guard BEFORE restoring: a mismatched expert count
+        # (the run evolved experts after this config was written) can
+        # restore without raising — orbax fills the target tree it is
+        # given — so the actionable error must come from the checkpoint's
+        # own metadata, never from hoping the restore fails.
+        saved_e = None
+        try:
+            saved_cfg = (self.checkpoints.load_metadata(step) or {}).get(
+                "config", {}
+            )
+            # Only an MoE tree bakes the expert count into param shapes;
+            # a dense checkpoint's num_experts field is inert config.
+            if saved_cfg.get("use_moe"):
+                saved_e = saved_cfg.get("num_experts")
+        except Exception:
+            pass  # unreadable metadata: the corrupt-restore path decides
+        if saved_e is not None and saved_e != self.config.num_experts:
+            raise ValueError(
+                f"checkpoint at step {step} was saved with num_experts="
+                f"{saved_e} (architecture evolved mid-run) but config has "
+                f"{self.config.num_experts}; set num_experts={saved_e} to "
+                "resume"
+            )
+        used = step
         try:
             self.state = self.checkpoints.restore(self.state, step)
         except Exception as e:
-            # Most common cause: the run evolved experts after this config
-            # was written, so the stored tree has a different expert count.
-            try:
-                meta = self.checkpoints.load_metadata(step)
-                saved_e = meta.get("config", {}).get("num_experts")
-            except Exception:
-                saved_e = None
-            if saved_e is not None and saved_e != self.config.num_experts:
-                raise ValueError(
-                    f"checkpoint at step {step} was saved with num_experts="
-                    f"{saved_e} (architecture evolved mid-run) but config has "
-                    f"{self.config.num_experts}; set num_experts={saved_e} to "
-                    "resume"
-                ) from e
-            raise
+            # Architecture matches but the restore failed: the latest
+            # checkpoint is corrupt/partial (kill mid-commit, disk-full).
+            # Count it and walk back to the newest INTACT older step
+            # instead of refusing to resume (docs/resilience.md).
+            self.checkpoints._m_fallbacks.inc()
+            older = [
+                s for s in self.checkpoints.all_steps()
+                if s < step and s >= self._min_restorable_step
+            ]
+            if not older:
+                raise
+            logger.warning(
+                "latest checkpoint (step %d) failed to restore (%s: %s); "
+                "falling back to an older intact one",
+                step, type(e).__name__, str(e)[:200],
+            )
+            self.state, used, _ = self.checkpoints.restore_with_fallback(
+                self.state, step=max(older),
+                min_step=self._min_restorable_step,
+            )
         self.global_step = int(self.state.step)
-        logger.info("resumed from checkpoint at step %d", self.global_step)
+        self._load_data_state(used)
+        logger.info(
+            "resumed from checkpoint at step %d (exact data state: %s)",
+            self.global_step, self._resumed_exact_data_state,
+        )
         return True
+
+    def _data_state(self) -> Optional[Dict[str, Any]]:
+        """The loader's exact-resume cursor, with epoch/batch_index taken
+        from THIS loop's consumption counters (the loader prefetches
+        ahead; the trainer knows what was trained). None when the data
+        callable has no checkpointable state."""
+        sd = getattr(self.train_data, "state_dict", None)
+        if not callable(sd):
+            return None
+        try:
+            state = dict(sd())
+        except Exception as e:  # never let data state cost the checkpoint
+            logger.warning("data state_dict failed: %s", e)
+            return None
+        state["epoch"] = self._data_epoch
+        state["batch_index"] = self._batch_in_epoch
+        return state
+
+    def _load_data_state(self, step: int) -> None:
+        """Fast-forward the data loader to the cursor saved with `step`,
+        so the resumed batch stream continues bitwise-identically (no
+        batch replayed or dropped). Degrades to a logged warning when the
+        checkpoint predates data-state metadata or the loader has no
+        load_state_dict."""
+        self._resumed_exact_data_state = False
+        try:
+            meta = self.checkpoints.load_metadata(step) or {}
+        except Exception:
+            return
+        ds_state = meta.get("data_state")
+        if not ds_state:
+            logger.warning(
+                "checkpoint %d carries no data state; resumed batches may "
+                "replay or skip data", step,
+            )
+            return
+        ld = getattr(self.train_data, "load_state_dict", None)
+        if not callable(ld):
+            logger.warning(
+                "data loader has no load_state_dict; resumed batches may "
+                "replay or skip data"
+            )
+            return
+        try:
+            ld(dict(ds_state))
+        except Exception as e:
+            logger.warning("data state restore failed: %s", e)
+            return
+        self._data_epoch = int(ds_state.get("epoch", 0))
+        self._batch_in_epoch = int(ds_state.get("batch_index", 0))
+        self._resumed_exact_data_state = True
+        logger.info(
+            "data loader fast-forwarded to epoch %d batch %d",
+            self._data_epoch, self._batch_in_epoch,
+        )
 
     def save_checkpoint(self, metrics=None, force: bool = False) -> None:
         with self.tracer.span("checkpoint_save", step=self.global_step):
             self.checkpoints.save(
-                self.state, self.global_step, metrics, force=force
+                self.state, self.global_step, metrics, force=force,
+                data_state=self._data_state(),
             )
+
+    def request_stop(self, reason: str = "preemption") -> None:
+        """Arm a graceful stop at the NEXT step boundary (SIGTERM/SIGINT
+        preemption path). Signal-handler-safe: only sets a flag; the
+        loop does the blocking emergency save from its own thread."""
+        self._stop_requested = reason or "preemption"
 
     def _count_recompile(self, reason: str) -> None:
         """Every train-step rebuild retraces + recompiles; the counter
@@ -763,6 +876,10 @@ class Trainer:
         last_metrics: Dict[str, Any] = {}
         log_every = max(1, cfg.health_check_interval // 10)
         stop = False
+        # A fresh train() call starts unpreempted (in-process restart in
+        # tests / notebooks); a pre-armed request_stop still honors at the
+        # first step boundary.
+        self._preempted = False
 
         epoch = 0
         # Throughput is measured over whole windows between log events, with
@@ -782,6 +899,7 @@ class Trainer:
                 self._maybe_profile()
                 self.state, metrics = self.train_step(self.state, batch)
                 self.global_step += 1
+                self._batch_in_epoch += 1
                 n_tok = int(batch["input_ids"].size)
                 tokens_seen += n_tok
                 window_tokens += n_tok
@@ -877,15 +995,43 @@ class Trainer:
                     self._last_backup_time = time.time()
                     window_t0, window_tokens, window_steps = time.time(), 0, 0
 
+                if self._stop_requested:
+                    # Preemption: stop at this step boundary with a
+                    # BLOCKING emergency save (the orbax commit lands
+                    # before we return), so the process can exit with a
+                    # resumable checkpoint + exact data cursor.
+                    reason = self._stop_requested
+                    logger.warning(
+                        "stop requested (%s): emergency save at step %d",
+                        reason, self.global_step,
+                    )
+                    self._preempted = True
+                    self._m_preemptions.inc()
+                    self.checkpoints.emergency_save(
+                        self.state, self.global_step, reason=reason,
+                        data_state=self._data_state(),
+                    )
+                    stop = True
+                    break
+            else:
+                # Epoch iterator exhausted with no break: one full data
+                # pass consumed — advance the exact-resume cursor.
+                self._data_epoch += 1
+                self._batch_in_epoch = 0
+
             if (
                 self.steps_per_epoch is not None
                 and epoch >= cfg.num_epochs
             ):
                 break
 
-        final_eval = self.evaluate() if self.eval_data is not None else {}
-        last_metrics.update(final_eval)
-        self.save_checkpoint(last_metrics, force=True)
+        final_eval: Dict[str, float] = {}
+        if not self._preempted:
+            # A preempted run already banked its emergency checkpoint and
+            # is racing the platform's grace period: skip final eval/save.
+            final_eval = self.evaluate() if self.eval_data is not None else {}
+            last_metrics.update(final_eval)
+            self.save_checkpoint(last_metrics, force=True)
         self.checkpoints.wait()
 
         elapsed = time.time() - t_start
@@ -898,6 +1044,8 @@ class Trainer:
             "final_metrics": {k: v for k, v in last_metrics.items()},
             "health": self.monitor.get_health_summary(),
             "interventions": self._interventions,
+            "preempted": self._preempted,
+            "resumed_exact_data_state": self._resumed_exact_data_state,
         }
         logger.info("training done: %s", summary)
         return summary
@@ -1037,7 +1185,8 @@ class Trainer:
             safe,
         )
         self.checkpoints.emergency_save(
-            self.state, self.global_step, "non-finite loss, no rollback point"
+            self.state, self.global_step, "non-finite loss, no rollback point",
+            data_state=self._data_state(),
         )
         return True
 
