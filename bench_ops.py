@@ -5,8 +5,10 @@ Times the repo's competing op implementations head-to-head on the current
 backend (real TPU under the default platform; CPU with JAX_PLATFORMS=cpu):
 
   - attention: Pallas flash kernel vs XLA einsum fallback (fwd and fwd+bwd)
-  - MoE dispatch: sort (scatter/gather) vs einsum (one-hot) (fwd and fwd+bwd)
+  - MoE dispatch: sort vs gather vs einsum (one-hot) vs ragged gmm
   - loss: fused LM-head CE (chunked) vs plain logits CE (fwd+bwd)
+  - int8: bf16 vs W8A8 at the decode vocab-projection shape
+  - rope: fp32 vs bf16 rotation at the flagship q-projection shape
 
 Prints one human-readable table plus a final JSON line for tooling. Timing
 boundaries force a host transfer (float/device_get) — block_until_ready
@@ -171,6 +173,53 @@ def bench_loss(B=8, S=2048, H=1024, V=32768) -> List[Dict]:
     return rows
 
 
+def bench_rope(B=16, S=2048, Hq=16, D=64) -> List[Dict]:
+    """RoPE rotation dtype A/B at flagship q-projection shape: fp32 table
+    math (an fp32 [B,S,H,D] round-trip per projection — ~71ms/step across
+    the flagship's q+k applications in the r3 trace) vs rotation in the
+    bf16 compute dtype (config.rope_dtype='bf16', the r6 tuned default).
+    Inputs/outputs are bf16 either way; only the product rounding differs
+    (parity pinned in tests/test_model.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    from luminaai_tpu.models.layers import apply_rope, rope_frequencies
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(B, S, Hq, D), jnp.bfloat16)
+    cos, sin = rope_frequencies(D, S)
+
+    variants = (
+        ("fp32", jax.jit(
+            lambda x: apply_rope(x, cos, sin, compute_dtype=jnp.float32)
+        )),
+        ("bf16", jax.jit(
+            lambda x: apply_rope(x, cos, sin, compute_dtype=jnp.bfloat16)
+        )),
+    )
+
+    def grad_wrap(f):
+        return jax.jit(
+            jax.grad(lambda x: f(x).astype(jnp.float32).sum())
+        )
+
+    shape = f"B{B}xS{S}xH{Hq}xD{D}"
+    rows = []
+    for name, f in variants:
+        rows.append({
+            "op": f"rope_{name}_fwd",
+            "ms": _time_fn(f, x) * 1e3,
+            "shape": shape,
+        })
+    for name, f in variants:
+        rows.append({
+            "op": f"rope_{name}_fwdbwd",
+            "ms": _time_fn(grad_wrap(f), x) * 1e3,
+            "shape": shape,
+        })
+    return rows
+
+
 def bench_int8_matmul(M=256, K=1024, N=32768) -> List[Dict]:
     """bf16 vs W8A8 int8 at the decode vocab-projection shape — the MXU
     int8-peak claim (v5e ~2x bf16) measured directly, plus the full
@@ -221,6 +270,8 @@ def _run_suite(suite: str, small: bool) -> List[Dict]:
     if suite == "int8":
         return bench_int8_matmul(**(dict(M=32, K=128, N=2048)
                                     if small else {}))
+    if suite == "rope":
+        return bench_rope(**(dict(B=2, S=256, Hq=4, D=64) if small else {}))
     return bench_loss(**(dict(B=2, S=256, H=128, V=2048) if small else {}))
 
 
@@ -240,7 +291,7 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument(
         "--suite", default="all",
-        choices=["all", "attention", "moe", "loss", "int8"],
+        choices=["all", "attention", "moe", "loss", "int8", "rope"],
     )
     parser.add_argument("--small", action="store_true",
                         help="CPU-sized shapes for smoke testing")
@@ -249,7 +300,7 @@ def main() -> None:
     args = parser.parse_args()
 
     suites = (
-        ["attention", "moe", "loss", "int8"]
+        ["attention", "moe", "loss", "int8", "rope"]
         if args.suite == "all" else [args.suite]
     )
     rows: List[Dict] = []

@@ -158,6 +158,15 @@ class Config:
     # moment vs 4; ref trainer.py:771 create_quantized_optimizer).
     adam_state_quantization: Optional[str] = None
     scan_layers: bool = False  # lax.scan over layers (homogeneous stacks)
+    # Degrade scan_layers instead of crashing when its first compile dies
+    # in the backend's remote-compile helper (the on-chip
+    # `remote_compile HTTP 500: tpu_compile_helper subprocess exit code 1`
+    # class — scripts/repro_scan500.py is the root-cause ladder): the
+    # trainer rebuilds the step with scan_layers=False, logs the failure,
+    # and counts train_recompiles_total{reason="scan500_fallback"}. Only
+    # engages at step 0 on a single-stage config (pipeline parallelism
+    # REQUIRES the scanned layout, so there it re-raises).
+    scan_compile_fallback: bool = True
     donate_state: bool = True
     eval_every_n_batches: int = 500
     save_every_n_batches: int = 1000
@@ -396,19 +405,29 @@ class Config:
                 # The megablox grouped-matmul kernel is a Pallas custom
                 # call GSPMD cannot partition, so gmm runs under shard_map
                 # (models/moe.py _gmm_path): tokens shard over data/fsdp,
-                # experts over 'expert', partial outputs psum over
-                # 'expert'. tensor/sequence/pipe would split the hidden or
-                # sequence dimension INSIDE the kernel's rows — not
-                # expressible in that layout; use 'gather' there.
+                # experts over 'expert', and (r6) the expert FFN dims over
+                # 'tensor' — gate/up column-parallel, wo row-parallel —
+                # with partial outputs psum'd over ('expert', 'tensor').
+                # sequence/pipe would split the kernel's sorted row
+                # dimension itself — not expressible; use 'gather' there.
                 for name, size in (
                     ("pipeline", self.pipeline_parallel_size),
                     ("sequence", self.sequence_parallel_size),
-                    ("tensor", self.tensor_parallel_size),
                 ):
                     assert size == 1, (
                         f"moe_dispatch='gmm' composes with data/fsdp/"
-                        f"expert mesh axes only ({name}_parallel_size="
-                        f"{size}); use 'gather' or 'sort' there"
+                        f"expert/tensor mesh axes only ({name}_parallel_"
+                        f"size={size}); use 'gather' or 'sort' there"
+                    )
+                if self.tensor_parallel_size > 1:
+                    assert (
+                        self.intermediate_size % self.tensor_parallel_size
+                        == 0
+                    ), (
+                        "moe_dispatch='gmm' with tensor parallelism needs "
+                        "intermediate_size divisible by tensor_parallel_"
+                        f"size ({self.intermediate_size} % "
+                        f"{self.tensor_parallel_size})"
                     )
                 # num_experts % expert_parallel_size is enforced by the
                 # unconditional expert-parallel check below.

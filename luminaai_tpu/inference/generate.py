@@ -417,10 +417,45 @@ class GenerationEngine:
         construction — temperature/top-p sampling would need rejection
         resampling; use generate() for sampled decoding.
 
+        Blocking collector over generate_stream_speculative — one decode
+        loop serves both the JSON and the SSE serving paths.
+
         (The reference has no speculative path; its decode re-runs the
         full model per token, Chat.py:346. This is a TPU-first serving
         addition: decode is HBM-bound, so scoring k rows costs ~one step.)
         """
+        tokens: List[int] = []
+        stats: Dict[str, Any] = {}
+        for item in self.generate_stream_speculative(
+            prompt_tokens, max_new_tokens=max_new_tokens,
+            draft_k=draft_k, seed=seed,
+        ):
+            if isinstance(item, dict):
+                stats = item
+            else:
+                tokens.append(int(item))
+        return tokens, stats
+
+    def generate_stream_speculative(
+        self,
+        prompt_tokens: Sequence[int],
+        max_new_tokens: Optional[int] = None,
+        draft_k: int = 8,
+        seed: Optional[int] = None,
+        timeout_s: Optional[float] = None,
+    ):
+        """Streaming prompt-lookup speculative decode: the SSE-facing twin
+        of generate_speculative, honoring the generate_stream contract —
+        token ints as they are ACCEPTED, then one final stats dict. Each
+        verify round can release several tokens at once, so frames arrive
+        in accepted-prefix bursts; the token sequence is exactly the plain
+        greedy stream's. When the rolling-window cache leaves no slack for
+        a k-row verify, it degrades to the chunked greedy stream.
+
+        timeout_s bounds the decode loop (checked per verify round): on
+        expiry the stream ends early with stopped='timeout' — the serving
+        layer passes its per-request deadline here, since speculative
+        streams run outside the continuous scheduler's lane eviction."""
         max_new = int(max_new_tokens or self.config.max_new_tokens)
         k = max(2, int(draft_k))
         w = getattr(self.config, "attention_window", None)
@@ -437,10 +472,41 @@ class GenerationEngine:
             if slots < self.config.seq_length:  # rolling actually engages
                 k = min(k, slots - w + 1)
                 if k < 2:
-                    return self.generate(
+                    # Degrade to the plain greedy stream WITHOUT dropping
+                    # the deadline: generate_stream has no timeout
+                    # parameter, so enforce it here per yielded token —
+                    # the serving layer routed this stream outside the
+                    # scheduler's eviction on the promise that the engine
+                    # loop honors timeout_s.
+                    start = time.time()
+                    produced = 0
+                    src = self.generate_stream(
                         prompt_tokens, max_new_tokens=max_new,
                         temperature=0.0, repetition_penalty=1.0, seed=seed,
                     )
+                    for item in src:
+                        if isinstance(item, dict):
+                            yield item
+                            return
+                        yield item
+                        produced += 1
+                        if (
+                            timeout_s is not None
+                            and time.time() - start > timeout_s
+                        ):
+                            src.close()
+                            dt = time.time() - start
+                            yield {
+                                "tokens_generated": produced,
+                                "seconds": round(dt, 3),
+                                "tokens_per_second": round(
+                                    produced / max(dt, 1e-9), 1
+                                ),
+                                "prompt_tokens": len(prompt_tokens),
+                                "stopped": "timeout",
+                            }
+                            return
+                    return
         gen_key = (max_new, 0.0, 0, 1.0, 1.0)  # greedy, no penalty
         t0 = time.time()
         # Trim leaves room for the verify overshoot (up to k-1 cache rows
@@ -451,18 +517,25 @@ class GenerationEngine:
         )
         del counts, rng  # greedy without penalty needs neither
         verify_calls = 0
-        tokens: List[int] = []
+        produced = 0
         stopped = "length"
         if first_is_stop:
             stopped = "eos"
         elif max_new >= 1:
-            tokens = [int(first_token)]
-            index = _NgramIndex(list(prompt) + tokens)
+            yield int(first_token)
+            produced = 1
+            index = _NgramIndex(list(prompt) + [int(first_token)])
             verify = self._get_verify(k)
             fn_stop = self._stop_set
             pos = length  # next cache row to write
             token = int(first_token)  # accepted, not yet fed
-            while len(tokens) < max_new:
+            while produced < max_new:
+                if (
+                    timeout_s is not None
+                    and time.time() - t0 > timeout_s
+                ):
+                    stopped = "timeout"
+                    break
                 draft = index.propose(k - 1)
                 ids = [token] + draft + [-1] * (k - 1 - len(draft))
                 nxt, caches = verify(
@@ -486,9 +559,10 @@ class GenerationEngine:
                         stopped = "eos"
                         done = True
                         break
-                    tokens.append(t)
+                    yield int(t)
+                    produced += 1
                     index.append(t)
-                    if len(tokens) >= max_new:
+                    if produced >= max_new:
                         done = True
                         break
                 # Cache rows 0..j carried correct tokens; the next round
@@ -499,18 +573,17 @@ class GenerationEngine:
                 if done:
                     break
         dt = time.time() - t0
-        stats = {
-            "tokens_generated": len(tokens),
+        yield {
+            "tokens_generated": produced,
             "seconds": round(dt, 3),
-            "tokens_per_second": round(len(tokens) / max(dt, 1e-9), 1),
+            "tokens_per_second": round(produced / max(dt, 1e-9), 1),
             "prompt_tokens": length,
             "stopped": stopped,
             "verify_calls": verify_calls,
             "tokens_per_verify": round(
-                len(tokens) / max(verify_calls, 1), 2
+                produced / max(verify_calls, 1), 2
             ),
         }
-        return tokens, stats
 
     def _prefill_and_sample_first(self, prompt_tokens, gen_key, seed):
         """Shared prompt->first-token path for generate/generate_stream:

@@ -159,6 +159,15 @@ _dispatch_gather.defvjp(_dispatch_gather_fwd, _dispatch_gather_bwd)
 # the CPU fallback below self-masks and cannot exercise it.
 _GMM_OVERRIDE = None
 
+# megablox m-dimension tile: the kernel walks the sorted row buffer in
+# 128-row tiles, so the buffer is padded UP to this boundary. Pad rows sit
+# past sum(group_sizes) — the same excluded tail dropped pairs already use
+# — so they cost no kernel work and their (uninitialized) outputs/grads are
+# annihilated by the row_kept operand masks. This replaced the r5-era
+# shape fence (_check_gmm_rows ValueError): any batch/seq/top_k now runs
+# dropless (VERDICT r5 #6).
+_GMM_ROW_TILE = 128
+
 
 def _top_k_routing(
     router_probs: jax.Array, top_k: int, capacity: int
@@ -489,7 +498,18 @@ class MoELayer(nn.Module):
         kept rows, so the zero-padding win survives dp/fsdp/ep
         composition. A psum over 'expert' combines the partial token
         outputs (each pair contributes on exactly the shard owning its
-        expert). tensor/sequence/pipe stay unsupported (config rejects).
+        expert).
+
+        tensor composes too (r6): wi enters as SEPARATE gate/up halves
+        each column-sharded over 'tensor' (the fused [., 2F] layout can't
+        shard directly — a contiguous 2F/tp slice would put all of gate
+        on the low shards and all of up on the high ones, breaking the
+        local silu(gate)*up), wo is row-sharded over its F dim, and each
+        shard's partial token outputs join the same psum — now over
+        ('expert', 'tensor'). This is Megatron column-then-row parallelism
+        expressed inside the shard_map body; the only per-block collective
+        stays the output psum. sequence/pipe remain unsupported (config
+        rejects — they would split the kernel's row dimension).
 
         Returns (combined_out [G,S,H], tokens_per_expert [E], dropped [G,S]).
         """
@@ -498,7 +518,7 @@ class MoELayer(nn.Module):
         E, k = cfg.num_experts, cfg.moe_top_k
         gmm = _pick_gmm()
 
-        from luminaai_tpu.parallel.mesh import active_mesh
+        from luminaai_tpu.parallel.mesh import active_mesh, shard_map
 
         mesh = active_mesh()
         multi = mesh is not None and mesh.size > 1
@@ -506,15 +526,13 @@ class MoELayer(nn.Module):
             # Single device — or flax init, whose 1-row dummy batch can't
             # satisfy the sharded layout and whose activations are dead
             # code anyway (only param shapes survive init).
-            if not self.is_initializing():
-                _check_gmm_rows(G * S * k, 1)
             return _gmm_local(
                 x, router_probs, wi, wo,
                 top_k=k, capacity=capacity, num_experts=E,
                 dtype=self.dtype, gmm_fn=gmm, ep_axis=None,
             )
 
-        for ax in ("tensor", "sequence", "pipe"):
+        for ax in ("sequence", "pipe"):
             if mesh.shape.get(ax, 1) > 1:
                 raise ValueError(
                     f"moe_dispatch='gmm' does not compose with the "
@@ -527,45 +545,68 @@ class MoELayer(nn.Module):
                 f"gmm dispatch needs batch groups ({G}) divisible by "
                 f"data*fsdp ({dp_total})"
             )
-        _check_gmm_rows(G * S * k, dp_total)
+        tp = mesh.shape.get("tensor", 1)
 
         from jax.sharding import PartitionSpec as P
 
         tok_spec = P(("data", "fsdp"), None, None)
 
-        def body(x_l, probs_l, wi_l, wo_l):
+        if tp == 1:
+            def body(x_l, probs_l, wi_l, wo_l):
+                out, tpe, dropped = _gmm_local(
+                    x_l, probs_l, wi_l, wo_l,
+                    top_k=k, capacity=capacity, num_experts=E,
+                    dtype=self.dtype, gmm_fn=gmm, ep_axis="expert",
+                )
+                # Each pair's FFN output lives on the shard owning its
+                # expert; tokens are replicated over 'expert', so a psum
+                # assembles the full combine. tokens_per_expert sums the
+                # per-token-shard local counts into the global [E] the
+                # aux-loss math expects.
+                out = jax.lax.psum(out, "expert")
+                tpe = jax.lax.psum(tpe, ("data", "fsdp"))
+                return out, tpe, dropped
+
+            sharded = shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(tok_spec, tok_spec, P("expert", None, None),
+                          P("expert", None, None)),
+                out_specs=(tok_spec, P(), P(("data", "fsdp"), None)),
+                check_vma=False,
+            )
+            return sharded(x, router_probs, wi, wo)
+
+        # expert x tensor: pass gate/up halves so each tensor shard holds
+        # MATCHED F/tp column slices of both (config.validate enforces
+        # F % tp == 0). wo row-shards over the same F slices, so
+        # silu(gate)*up and the down-projection stay shard-local; the
+        # psum over ('expert', 'tensor') assembles the token outputs.
+        F = wi.shape[-1] // 2
+
+        def body_tp(x_l, probs_l, wi_g_l, wi_u_l, wo_l):
+            wi_l = jnp.concatenate([wi_g_l, wi_u_l], axis=-1)
             out, tpe, dropped = _gmm_local(
                 x_l, probs_l, wi_l, wo_l,
                 top_k=k, capacity=capacity, num_experts=E,
                 dtype=self.dtype, gmm_fn=gmm, ep_axis="expert",
             )
-            # Each pair's FFN output lives on the shard owning its expert;
-            # tokens are replicated over 'expert', so a psum assembles the
-            # full combine. tokens_per_expert sums the per-token-shard
-            # local counts into the global [E] the aux-loss math expects.
-            out = jax.lax.psum(out, "expert")
+            out = jax.lax.psum(out, ("expert", "tensor"))
             tpe = jax.lax.psum(tpe, ("data", "fsdp"))
             return out, tpe, dropped
 
-        sharded = jax.shard_map(
-            body,
+        sharded = shard_map(
+            body_tp,
             mesh=mesh,
-            in_specs=(tok_spec, tok_spec, P("expert", None, None),
-                      P("expert", None, None)),
+            in_specs=(
+                tok_spec, tok_spec,
+                P("expert", None, "tensor"), P("expert", None, "tensor"),
+                P("expert", "tensor", None),
+            ),
             out_specs=(tok_spec, P(), P(("data", "fsdp"), None)),
             check_vma=False,
         )
-        return sharded(x, router_probs, wi, wo)
-
-
-def _check_gmm_rows(n_rows: int, dp_total: int) -> None:
-    local = n_rows // max(dp_total, 1)
-    if local % 128 != 0:
-        raise ValueError(
-            f"gmm dispatch needs per-shard groups*seq*top_k rows ({local}) "
-            "to be a multiple of the 128-row kernel tile; use 'gather' "
-            "dispatch for this shape"
-        )
+        return sharded(x, router_probs, wi[..., :F], wi[..., F:], wo)
 
 
 def _pick_gmm():
@@ -641,6 +682,15 @@ def _gmm_local(
     perm = jnp.argsort(e_sort, stable=True)  # [N] pair ids, expert-major
     # Pair id p = ((g*S)+s)*k + r -> its token row in x_flat is p // k.
     x_flat = x.astype(dtype).reshape(G * S, H)
+    # Tile padding: megablox walks the sorted buffer in 128-row tiles, so
+    # the buffer rounds UP to the boundary. Pad rows are zeros appended
+    # past row N — and total_kept <= N always, so they sit in the same
+    # excluded tail dropped pairs use: group_sizes never reaches them, no
+    # kernel tile processes them beyond the ragged remainder, and the
+    # row_kept masks below annihilate whatever the kernel leaves there.
+    # This is what makes ANY batch/seq/top_k combination dropless — the
+    # r5-era 128-row shape fence raised instead.
+    N_pad = -(-N // _GMM_ROW_TILE) * _GMM_ROW_TILE
     # Rows past sum(group_sizes) are never touched by the kernel: its
     # forward leaves those output tiles uninitialized, and its custom
     # VJP leaves the matching grad_lhs rows uninitialized too (it only
@@ -650,17 +700,22 @@ def _gmm_local(
     # into real tokens' d_x through the x_flat[perm//k] gather VJP.
     # jnp.where on the OPERANDS fixes both directions: its VJP selects
     # (rather than multiplies), so cotangents for masked rows are
-    # annihilated exactly, and NaN garbage cannot leak through.
+    # annihilated exactly, and NaN garbage cannot leak through. (The pad
+    # rows ride the same masks; jnp.pad's VJP is a slice, so their
+    # cotangents simply fall off.)
     total_kept = group_sizes.sum()
-    row_kept = jnp.arange(N)[:, None] < total_kept  # [N, 1]
-    lhs = jnp.where(row_kept, x_flat[perm // k], 0)  # [N, H] sorted rows
+    row_kept = jnp.arange(N_pad)[:, None] < total_kept  # [N_pad, 1]
+    rows = x_flat[perm // k]  # [N, H] sorted rows
+    if N_pad != N:
+        rows = jnp.pad(rows, ((0, N_pad - N), (0, 0)))
+    lhs = jnp.where(row_kept, rows, 0)  # [N_pad, H]
 
     fused = gmm_fn(
         lhs,
         wi.astype(dtype),
         group_sizes,
         preferred_element_type=dtype,
-    )  # [N, 2F]
+    )  # [N_pad, 2F]
     gate_act, up = jnp.split(fused, 2, axis=-1)
     act = jnp.where(row_kept, nn.silu(gate_act) * up, 0)
     yrow = gmm_fn(
@@ -668,11 +723,11 @@ def _gmm_local(
         wo.astype(dtype),
         group_sizes,
         preferred_element_type=dtype,
-    )  # [N, H]
+    )  # [N_pad, H]
     # Forward output tiles past the kept region are uninitialized too —
     # zero them before the unsort so garbage can't meet a
     # NaN-propagating gate product.
-    yrow = jnp.where(row_kept, yrow, 0.0)
+    yrow = jnp.where(row_kept, yrow, 0.0)[:N]
 
     inv_perm = jnp.argsort(perm)  # back to pair order
     y_pairs = yrow[inv_perm].reshape(G, S, k, H)

@@ -336,7 +336,9 @@ def ring_attention(
             causal=causal,
             window=window,
         )
-    sharded = jax.shard_map(
+    from luminaai_tpu.parallel.mesh import shard_map
+
+    sharded = shard_map(
         fn,
         mesh=mesh,
         in_specs=(q_spec, kv_spec, kv_spec),

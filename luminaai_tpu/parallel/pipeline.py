@@ -39,6 +39,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from luminaai_tpu.config import Config
 from luminaai_tpu.models.transformer import TransformerBlock, scan_segments
+from luminaai_tpu.parallel.mesh import shard_map
 from luminaai_tpu.parallel.sharding import TrainState
 from luminaai_tpu.parallel.train_step import (
     _ce,
@@ -248,7 +249,7 @@ def make_pipeline_loss_fn(
             {"params": params["embedder"]}, ids, method="encode"
         )
         stack = params["scan_0"]["block_0"]
-        sharded = jax.shard_map(
+        sharded = shard_map(
             pipe_body,
             mesh=mesh,
             axis_names=frozenset({"pipe"}),
@@ -598,7 +599,7 @@ def make_1f1b_loss_fn(config: Config, model, mesh: Mesh) -> Callable:
             "expert" if ep > 1 else None,
             "sequence" if sp > 1 else None,
         )
-        sharded = jax.shard_map(
+        sharded = shard_map(
             schedule_body,
             mesh=mesh,
             axis_names=frozenset(manual_axes),
@@ -818,7 +819,7 @@ def make_pipeline_fwd_metrics_fn(config: Config, model, mesh: Mesh) -> Callable:
             "expert" if ep > 1 else None,
             "sequence" if sp > 1 else None,
         )
-        sharded = jax.shard_map(
+        sharded = shard_map(
             schedule_body,
             mesh=mesh,
             axis_names=frozenset(manual_axes),

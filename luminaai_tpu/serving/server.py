@@ -35,6 +35,11 @@ text/event-stream: one `data: {"token", "delta"}` frame per generated
 token, a final `data: {"done": true, <text|reply>, tokens, latency_s,
 stopped}` frame, and a `data: [DONE]` terminator (engine.generate_stream's
 chunked decode; scripts/serve_load.py drives both modes under load).
+{"speculative": true} composes with both shapes on greedy requests: the
+JSON path runs generate_speculative, the SSE path streams the
+draft/verify loop (generate_stream_speculative, tokens in
+accepted-prefix bursts, verify stats on the done frame); ineligible or
+slot-starved requests silently take the normal path.
 
 No flask/fastapi in the image — http.server keeps the component
 dependency-free and testable in-process.
@@ -1185,6 +1190,24 @@ class ChatServer:
         )
         return 200, out
 
+    def _speculative_eligible(self, overrides) -> bool:
+        """Whether a {"speculative": true} hint can be honored for these
+        request params. Eligibility is judged on the RESOLVED params
+        (config defaults fill omitted fields — a request without
+        temperature usually samples): greedy, no repetition penalty.
+        Shared by the JSON and SSE paths so the hint means one thing."""
+        resolve = getattr(self.engine, "_resolve_gen_key", None)
+        if resolve is None:
+            return False
+        key = resolve(
+            overrides.get("max_new_tokens"),
+            overrides.get("temperature"),
+            overrides.get("top_p"),
+            overrides.get("top_k"),
+            overrides.get("repetition_penalty"),
+        )
+        return key[1] <= 0.0 and key[4] == 1.0
+
     def _run_speculative(self, prompt_ids, overrides, reply_key, t0):
         """Greedy requests with {"speculative": true} run the engine's
         prompt-lookup speculative decode (exactly the greedy sequence,
@@ -1194,20 +1217,7 @@ class ChatServer:
         or the engine lacks the method) so the caller falls back."""
         if not hasattr(self.engine, "generate_speculative"):
             return None
-        resolve = getattr(self.engine, "_resolve_gen_key", None)
-        if resolve is None:
-            return None
-        # Eligibility is judged on the RESOLVED params (config defaults
-        # fill omitted fields — a request without temperature usually
-        # samples): greedy, no repetition penalty.
-        key = resolve(
-            overrides.get("max_new_tokens"),
-            overrides.get("temperature"),
-            overrides.get("top_p"),
-            overrides.get("top_k"),
-            overrides.get("repetition_penalty"),
-        )
-        if key[1] > 0.0 or key[4] != 1.0:
+        if not self._speculative_eligible(overrides):
             return None
         if not self._stream_slots.acquire(blocking=False):
             # All slots busy: fall back to the batched path rather than
@@ -1251,6 +1261,31 @@ class ChatServer:
         if err is not None:
             return err, None
         timeout_s = self._effective_timeout(body)
+        if (
+            body.get("speculative")
+            and hasattr(self.engine, "generate_stream_speculative")
+            and self._speculative_eligible(overrides)
+            and self._stream_slots.acquire(blocking=False)
+        ):
+            # Greedy SSE with {"speculative": true}: the draft/verify
+            # loop composes with the streaming contract — tokens arrive
+            # in accepted-prefix bursts (engine
+            # generate_stream_speculative). Single-stream like the JSON
+            # speculative path, so it borrows the stream slot cap even
+            # under the continuous scheduler; slots busy or sampled
+            # params fall through to the normal stream — the hint never
+            # makes a servable request fail. The per-request deadline
+            # applies: speculative streams run outside the continuous
+            # scheduler's overdue-lane eviction, so the engine's decode
+            # loop enforces it instead (stopped='timeout').
+            if timeout_s:
+                overrides = {**overrides, "timeout_s": timeout_s}
+            return None, _SlotStream(
+                self._stream_events(
+                    prompt_ids, overrides, reply_key, speculative=True
+                ),
+                self._stream_slots.release,
+            )
         if self.continuous and timeout_s:
             overrides = {**overrides, "timeout_s": timeout_s}
         if self.continuous:
@@ -1269,7 +1304,8 @@ class ChatServer:
             self._stream_slots.release,
         )
 
-    def _stream_events(self, prompt_ids, overrides, reply_key):
+    def _stream_events(self, prompt_ids, overrides, reply_key,
+                       speculative: bool = False):
         """Yield SSE event dicts: {'token','delta'} per token, then a
         final {'done': True, <reply_key>: full_text, ...stats}.
 
@@ -1308,9 +1344,17 @@ class ChatServer:
             self.mark_ready()
 
         # Continuous mode streams per-slot out of the shared scheduler
-        # loop; legacy engines run their own chunked decode. Either source
-        # honors the same contract (token ints, then a stats dict).
-        if self.continuous:
+        # loop; legacy engines run their own chunked decode; speculative
+        # greedy streams run the engine's draft/verify loop directly.
+        # Every source honors the same contract (token ints, then a
+        # stats dict).
+        if speculative:
+            src = self.engine.generate_stream_speculative(
+                prompt_ids,
+                max_new_tokens=overrides.get("max_new_tokens"),
+                timeout_s=overrides.get("timeout_s"),
+            )
+        elif self.continuous:
             src = self.batcher.submit_stream(prompt_ids, overrides)
         else:
             src = self.engine.generate_stream(prompt_ids, **overrides)
@@ -1318,7 +1362,7 @@ class ChatServer:
             for item in src:
                 if isinstance(item, dict):  # final stats yield
                     count(int(item.get("tokens_generated", 0)))
-                    yield {
+                    done_frame = {
                         "done": True,
                         reply_key: tok.decode(tokens),
                         # Flush tokens still held by the mid-codepoint
@@ -1332,6 +1376,16 @@ class ChatServer:
                         "latency_s": round(time.time() - t0, 3),
                         "stopped": item.get("stopped"),
                     }
+                    if item.get("verify_calls") is not None:
+                        # Speculative stream: the done frame carries the
+                        # acceptance stats the JSON path reports.
+                        done_frame["speculative"] = {
+                            "verify_calls": item.get("verify_calls"),
+                            "tokens_per_verify": item.get(
+                                "tokens_per_verify"
+                            ),
+                        }
+                    yield done_frame
                     return
                 tokens.append(int(item))
                 delta = tok.decode(tokens[base:])

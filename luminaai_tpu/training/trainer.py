@@ -115,6 +115,19 @@ class Trainer:
         self.config = config
         self.train_data = train_data
         self.eval_data = eval_data
+        ckpt_dir = checkpoint_dir or f"{config.output_dir}/checkpoints"
+        # A caller-provided model pins the layer layout: neither the
+        # marker re-apply nor the live scan500 degrade may swap it for a
+        # fresh LuminaTransformer (_scan500_eligible checks this).
+        self._model_provided = model is not None
+        if model is None:
+            # A previous run of this checkpoint dir degraded scan_layers
+            # after the remote-compile HTTP 500 (see _degrade_scan_layers):
+            # its checkpoints are in the UNSCANNED param layout, so the
+            # degrade must re-apply BEFORE the model/state build or resume
+            # restores into a mismatched tree (a caller-provided model
+            # pins the layout, so only the self-built path auto-applies).
+            self._apply_scan500_marker(ckpt_dir)
         self.model = model or LuminaTransformer(config)
         self.precision = PrecisionManager(config)
 
@@ -150,7 +163,6 @@ class Trainer:
         # recompile counters and health gauges ride one exposition path.
         self.registry = registry or get_registry()
         self.tracer = tracer or NULL_TRACER
-        ckpt_dir = checkpoint_dir or f"{config.output_dir}/checkpoints"
         self.checkpoints = CheckpointManager(
             config, ckpt_dir, registry=self.registry
         )
@@ -897,7 +909,13 @@ class Trainer:
                     break
                 first_step = self.global_step == self._run_start_step
                 self._maybe_profile()
-                self.state, metrics = self.train_step(self.state, batch)
+                try:
+                    self.state, metrics = self.train_step(self.state, batch)
+                except Exception as e:
+                    if not (first_step and self._scan500_eligible(e)):
+                        raise
+                    self._degrade_scan_layers(e)
+                    self.state, metrics = self.train_step(self.state, batch)
                 self.global_step += 1
                 self._batch_in_epoch += 1
                 n_tok = int(batch["input_ids"].size)
@@ -1122,6 +1140,8 @@ class Trainer:
         from luminaai_tpu.monitoring.attribution import (
             analytic_train_flops,
             compiled_cost_metrics,
+            donation_audit,
+            tree_bytes,
         )
 
         try:
@@ -1136,6 +1156,24 @@ class Trainer:
                     self.config.estimate_active_parameters(), tokens_per_step
                 ),
             )
+            # Donation audit rides the same export: alias coverage over
+            # the resident TrainState proves the in-place update compiled
+            # (a silent donation break doubles peak optimizer HBM — the
+            # r3 "optimizer + misc" bucket's failure mode).
+            audit = donation_audit(
+                result.get("memory"),
+                tree_bytes(self.state),
+                expected=self.config.donate_state,
+                registry=self.registry,
+            )
+            result["donation_audit"] = audit
+            if audit.get("flagged"):
+                logger.warning(
+                    "donation audit: alias coverage %.2f < %.2f — the "
+                    "train step is COPYING its donated state each step",
+                    audit.get("coverage") or 0.0,
+                    audit.get("threshold", 0.0),
+                )
             self._compiled_costs = result
             if result.get("available"):
                 xc = result.get("mfu_crosscheck") or {}
@@ -1159,6 +1197,121 @@ class Trainer:
             logger.warning("compiled cost analysis failed: %s", e)
 
     # -- failure handling --------------------------------------------------
+    _SCAN500_MARKERS = ("remote_compile", "tpu_compile_helper", "HTTP 500")
+    _SCAN500_MARKER_FILE = "scan500_fallback.json"
+
+    def _apply_scan500_marker(self, ckpt_dir: str) -> None:
+        """Re-apply a persisted scan500 degrade before any state builds:
+        checkpoints written after _degrade_scan_layers are in the
+        unscanned layout, so a restarted run whose config still says
+        scan_layers=True must flip BEFORE resume or the restore tree
+        mismatches (preemption-safe resume is a headline contract)."""
+        cfg = self.config
+        if not (
+            cfg.scan_layers
+            and cfg.scan_compile_fallback
+            and cfg.pipeline_parallel_size == 1
+        ):
+            return
+        marker = os.path.join(ckpt_dir, self._SCAN500_MARKER_FILE)
+        if not os.path.exists(marker):
+            return
+        logger.warning(
+            "scan500 fallback marker found at %s: re-applying "
+            "scan_layers=False so resume matches the degraded run's "
+            "checkpoint layout (delete the marker to retry scanned "
+            "compiles from scratch)",
+            marker,
+        )
+        cfg.scan_layers = False
+
+    def _write_scan500_marker(self, err: Exception) -> None:
+        try:
+            import json as _json
+
+            marker = os.path.join(
+                str(self.checkpoints.dir), self._SCAN500_MARKER_FILE
+            )
+            with open(marker, "w") as f:
+                _json.dump(
+                    {
+                        "degraded_at_step": self.global_step,
+                        "reason": str(err).splitlines()[0][:300],
+                        "at": time.strftime(
+                            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+                        ),
+                    },
+                    f,
+                    indent=2,
+                )
+        except OSError:
+            logger.warning(
+                "could not persist the scan500 fallback marker; a resumed "
+                "run must set scan_layers=False manually"
+            )
+
+    def _scan500_eligible(self, err: Exception) -> bool:
+        """True when a first-compile failure matches the scan_layers
+        remote-compile HTTP-500 class (scripts/repro_scan500.py is the
+        root-cause ladder) AND degrading is safe: the guard is on, the
+        config actually scans, no pipeline stage slicing depends on the
+        scanned layout, and no trained/restored weights exist yet
+        (scan_layers changes the param-tree layout, so the fallback
+        re-initializes — only sound at step 0)."""
+        cfg = self.config
+        if not (
+            cfg.scan_layers
+            and cfg.scan_compile_fallback
+            and cfg.pipeline_parallel_size == 1
+            and self.global_step == 0
+            # The degrade rebuilds a fresh LuminaTransformer — it must
+            # never silently discard a caller-provided model.
+            and not getattr(self, "_model_provided", False)
+        ):
+            return False
+        msg = str(err)
+        return any(m in msg for m in self._SCAN500_MARKERS)
+
+    def _degrade_scan_layers(self, err: Exception) -> None:
+        """Rebuild the whole step stack with scan_layers=False after the
+        scanned layout died in the backend's remote-compile helper —
+        training proceeds unscanned (slower compiles, identical numerics)
+        instead of crashing (VERDICT r5 #4)."""
+        logger.warning(
+            "scan_layers compile failed in the remote-compile helper "
+            "(%s); degrading to scan_layers=False and recompiling. "
+            "Root-cause ladder: python scripts/repro_scan500.py",
+            str(err).splitlines()[0][:200],
+        )
+        self.config.scan_layers = False
+        # Persist the degrade next to the checkpoints: everything saved
+        # from here on is in the unscanned layout, and a restart whose
+        # config still says scan_layers=True must re-apply the flip
+        # before resuming (_apply_scan500_marker).
+        self._write_scan500_marker(err)
+        self.model = LuminaTransformer(self.config)
+        self.state, self.shardings = init_sharded_state(
+            self.config, self.model, self.tx, self.mesh,
+            jax.random.key(self.config.seed),
+        )
+        self.train_step = make_train_step(
+            self.config, self.model, self.shardings, self.mesh,
+            self._active_schedule, self.tx,
+        )
+        self.eval_step = make_eval_step(
+            self.config, self.model, self.shardings, self.mesh
+        )
+        self._count_recompile("scan500_fallback")
+        self._interventions.append(
+            {
+                "step": self.global_step,
+                "kind": "scan500_fallback",
+                "from": True,
+                "to": False,
+                "reason": str(err).splitlines()[0][:200],
+            }
+        )
+
     def _handle_nonfinite(self) -> bool:
         """NaN/Inf loss: rollback strictly before first detection, else abort
         (ref trainer.py train_with_oom_fallback's instability ladder).

@@ -134,6 +134,44 @@ VARIANTS = {
         "adam_state_quantization": "int8",
         "rope_dtype": "bf16",
     },
+    # r6: flagship_tuned's NEW default composition (bench.py) — dropless
+    # tile-padded gmm + bf16 rope + save_attn + bf16 mu — plus its two
+    # single-lever inverses, so the on-chip session prices each lever
+    # against the same baseline: tuned_r6 vs tuned_r6_gather isolates
+    # gmm (the compiled-FLOPs audit says −31% step FLOPs), tuned_r6 vs
+    # tuned_r6_rope32 isolates the RoPE convert tax (~71ms/step in the
+    # r3 trace).
+    "tuned_r6": {
+        "moe_dispatch": "gmm",
+        "rope_dtype": "bf16",
+        "remat_policy": "save_attn",
+        "adam_mu_dtype": "bf16",
+    },
+    "tuned_r6_gather": {
+        "moe_dispatch": "gather",
+        "rope_dtype": "bf16",
+        "remat_policy": "save_attn",
+        "adam_mu_dtype": "bf16",
+    },
+    "tuned_r6_rope32": {
+        "moe_dispatch": "gmm",
+        "rope_dtype": "fp32",
+        "remat_policy": "save_attn",
+        "adam_mu_dtype": "bf16",
+    },
+    # Tile-padding rung: batch 6 x seq 1992 x top-2 = 23,904 pair rows
+    # (pads to 24,064 — NOT a multiple of 128 pre-pad), the shape class
+    # the r5 fence rejected outright. seq 1992 is 8-aligned but not
+    # flash-block aligned, so attention takes the XLA path — the rung
+    # measures that gmm runs (and what padding costs), not attention.
+    "gmm_pad": {
+        "moe_dispatch": "gmm",
+        "remat_policy": "save_attn",
+        "rope_dtype": "bf16",
+        "batch_size": 6,
+        "seq_length": 1992,
+        "micro_batch_size": None,
+    },
 }
 
 names = sys.argv[1:] or ["base", "dots", "scan", "einsum"]

@@ -18,6 +18,14 @@ trigger:
 
 Run on a live chip: `python scripts/repro_scan500.py [stage ...]`.
 Output appends to scripts/repro_scan500_out.txt.
+
+Until the root cause lands, training is guarded: with
+`Config.scan_compile_fallback = True` (the default) the trainer catches
+this failure class at the FIRST compile, degrades to scan_layers=False,
+and keeps training (counted as
+train_recompiles_total{reason="scan500_fallback"} and recorded in the
+intervention log). Pipeline parallelism requires the scanned layout, so
+pp configs re-raise instead — see training/trainer.py _scan500_eligible.
 """
 import os
 import subprocess

@@ -3,11 +3,14 @@
 # on-chip work and leave results in scripts/sweep_out3.txt. Single-shot:
 # exits after the queue drains.
 #
-# r5 queue: bench.py first (it now PERSISTS the headline to
+# r6 queue: bench.py first (it persists BOTH the ref_debug_moe headline
+# and the flagship_tuned capture into the per-config
 # scripts/last_good_bench.json, so one success fixes the artifact story
-# for good), then the HTTP-500 root-cause ladder, then the batched A/B
-# sweep (best_r4 + gmm + rope16 + long-context rungs), then op/serving
-# benches.
+# for good; flagship_tuned now runs dropless gmm + bf16 rope), then the
+# HTTP-500 root-cause ladder, then the A/B sweep — tuned_r6 vs its
+# gather/rope32 inverses (the gmm-vs-gather and rope-dtype flagship
+# A/Bs), the gmm_pad tile-padding rung, and the long8k_win1k windowed
+# rung — then op benches (incl. the new rope suite) and serving.
 cd /root/repo
 # Hard deadline: the DRIVER captures the round artifact (BENCH_r05) at
 # round end and needs the single chip free — this watcher must never be
@@ -40,7 +43,7 @@ while true; do
     echo "$(date -u +%FT%TZ) bench.py first (headline artifact before anything can wedge)" >> scripts/sweep_out3.txt
     stage 4200 python bench.py
     stage 3600 python scripts/repro_scan500.py
-    stage 6000 python scripts/perf_sweep.py attn best_r4 gmm rope16 b24_q8_attn_gather rope16_gmm b24_q8_gmm_attn b32_q8_attn_gather attn_blk512 long8k long8k_win1k
+    stage 6000 python scripts/perf_sweep.py tuned_r6 tuned_r6_gather tuned_r6_rope32 gmm_pad attn best_r4 b24_q8_gmm_attn b32_q8_attn_gather long8k long8k_win1k
     stage 2400 python bench_ops.py
     stage 1800 python scripts/serve_bench.py 2 4 8
     echo "$(date -u +%FT%TZ) queue done" >> scripts/sweep_out3.txt

@@ -555,3 +555,134 @@ def test_smoke_serve_emits_wellformed_continuous_metric():
         assert telem[hist]["p50"] <= telem[hist]["p95"] <= telem[hist]["p99"]
     assert telem["serve_admissions_total"] >= ex["requests"]
     assert telem["kv_pool_slot_reuses_total"] >= 1
+
+
+# -- per-config last-good cache (r6) ----------------------------------------
+def test_cache_keeps_headline_and_flagship_entries(hermetic_last_good):
+    """_persist_last_good merges per-config entries: a flagship capture
+    lands NEXT TO the ref_debug_moe headline, never instead of it, and
+    the file's top level mirrors the headline entry (VERDICT r5 2a)."""
+    bench._persist_last_good(_canned("ref_debug_moe"))
+    bench._persist_last_good(_canned("flagship_tuned"))
+    cached = json.loads(hermetic_last_good.read_text())
+    assert cached["value"] == 1_474_875.0  # top level = headline config
+    assert set(cached["configs"]) == {"ref_debug_moe", "flagship_tuned"}
+    assert cached["configs"]["flagship_tuned"]["value"] == 31_557.0
+    # Loader prefers the headline entry.
+    entry, reject = bench._load_last_good()
+    assert reject is None
+    assert entry["extras"]["config"] == "ref_debug_moe"
+    # A later flagship re-capture still doesn't displace the headline.
+    newer = _canned("flagship_tuned")
+    newer["value"] = 40_000.0
+    bench._persist_last_good(newer)
+    entry, _ = bench._load_last_good()
+    assert entry["extras"]["config"] == "ref_debug_moe"
+    assert bench._cached_config_entry("flagship_tuned")["value"] == 40_000.0
+
+
+def test_cache_migrates_legacy_single_entry(hermetic_last_good):
+    """A legacy single-entry file (the committed r3 artifact's shape) is
+    migrated into the configs map instead of being clobbered."""
+    bench._persist_last_good(_canned("flagship_tuned"))
+    legacy = json.loads(hermetic_last_good.read_text())
+    legacy.pop("configs")  # legacy files predate the map
+    hermetic_last_good.write_text(json.dumps(legacy))
+    bench._persist_last_good(_canned("ref_debug_moe"))
+    cached = json.loads(hermetic_last_good.read_text())
+    assert set(cached["configs"]) == {"ref_debug_moe", "flagship_tuned"}
+    assert cached["value"] == 1_474_875.0
+
+
+def test_tampered_headline_entry_rejected_in_configs(hermetic_last_good):
+    """Provenance validation applies to the configs-map entry the loader
+    prefers: doctoring the ref_debug_moe entry refuses the whole load
+    with a tampered note (no silent fallback to a stale sibling)."""
+    bench._persist_last_good(_canned("flagship_tuned"))
+    bench._persist_last_good(_canned("ref_debug_moe"))
+    cached = json.loads(hermetic_last_good.read_text())
+    cached["configs"]["ref_debug_moe"]["value"] = 9_999_999.0
+    cached["value"] = 9_999_999.0
+    hermetic_last_good.write_text(json.dumps(cached))
+    entry, reject = bench._load_last_good()
+    assert entry is None
+    assert "cached_tampered" in reject
+
+
+def test_emitted_headline_carries_cached_flagship(monkeypatch,
+                                                  hermetic_last_good):
+    """When the outage path emits the cached ref_debug_moe headline, the
+    most recent cached flagship rides along in extras so the MFU story
+    survives the tunnel being down."""
+    bench._persist_last_good(_canned("flagship_tuned"))
+    bench._persist_last_good(_canned("ref_debug_moe"))
+    monkeypatch.setattr(
+        bench, "_probe_backend",
+        lambda *a, **k: (None, "backend_probe=failed(attempts=1,waited=0s)"),
+    )
+    monkeypatch.setattr(
+        bench, "_run_child",
+        lambda n, t: (_canned("cpu_fallback"), f"{n}: ok"),
+    )
+    out = _run_main()
+    assert out["value"] == 1_474_875.0
+    assert out["extras"]["flagship_cached"]["value"] == 31_557.0
+    assert out["extras"]["flagship_cached"]["mfu"] == 0.229
+    assert "configs" not in out
+
+
+@pytest.mark.slow
+def test_smoke_embeds_dispatch_flops_and_donation_audit():
+    """bench.py --smoke is the CPU-provable evidence surface for the r6
+    MFU attack: the artifact must embed the gmm-vs-einsum compiled-FLOPs
+    A/B on the flagship-shaped train step with the >=10% reduction met,
+    a clean donation audit (state aliased in place), and the optimizer
+    memory breakdown — CI gates on exactly these fields."""
+    import subprocess
+    import sys
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("PYTHONPATH", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(bench.__file__), "--smoke"],
+        capture_output=True,
+        text=True,
+        timeout=540,
+        cwd=os.path.dirname(os.path.abspath(bench.__file__)),
+        env=env,
+    )
+    lines = [
+        l for l in proc.stdout.splitlines() if l.strip().startswith("{")
+    ]
+    assert len(lines) == 1, (proc.stdout, proc.stderr[-2000:])
+    result = json.loads(lines[0])
+    assert proc.returncode == 0, (result, proc.stderr[-1000:])
+    ex = result["extras"]
+    ab = ex["moe_dispatch_flops"]
+    assert ab["available"], ab
+    assert ab["gmm_flops_per_step"] < ab["einsum_flops_per_step"]
+    assert ab["reduction"] >= 0.10, ab
+    assert ab["meets_10pct_target"] is True
+    aud = ex["donation_audit"]
+    assert aud["available"] and aud["coverage"] > 0.9, aud
+    assert aud["flagged"] is False
+    assert ex["optimizer_memory"]["total_bytes"] > 0
+
+
+def test_emitted_flagship_headline_does_not_self_duplicate(
+    monkeypatch, hermetic_last_good
+):
+    """A cache holding ONLY a flagship capture emits it as the headline
+    without re-attaching its own numbers as extras.flagship_cached."""
+    bench._persist_last_good(_canned("flagship_tuned"))
+    monkeypatch.setattr(
+        bench, "_probe_backend",
+        lambda *a, **k: (None, "backend_probe=failed(attempts=1,waited=0s)"),
+    )
+    monkeypatch.setattr(
+        bench, "_run_child",
+        lambda n, t: (_canned("cpu_fallback"), f"{n}: ok"),
+    )
+    out = _run_main()
+    assert out["value"] == 31_557.0
+    assert "flagship_cached" not in out["extras"]

@@ -80,6 +80,37 @@ class TestRoPE:
             return float(jnp.sum(qp * kp))
         assert dot_at(3, 7) == pytest.approx(dot_at(100, 104), abs=1e-3)
 
+    def test_bf16_rotation_parity(self, rng):
+        """rope_dtype='bf16' (the r6 flagship_tuned default) only changes
+        the PRODUCT rounding: bf16 inputs/outputs are quantized either
+        way, so the two rotations must agree to bf16 resolution — on the
+        table path AND the explicit-positions path — and bf16 rotation
+        must still preserve norms."""
+        d, S = 64, 128
+        cos, sin = rope_frequencies(d, S)
+        x = jax.random.normal(rng, (2, S, 4, d)).astype(jnp.bfloat16)
+        ref = apply_rope(x, cos, sin, compute_dtype=jnp.float32).astype(
+            jnp.float32
+        )
+        out = apply_rope(x, cos, sin, compute_dtype=jnp.bfloat16).astype(
+            jnp.float32
+        )
+        # |x| ~ N(0,1): 2 bf16 ulps of headroom at the observed scale.
+        assert float(jnp.max(jnp.abs(out - ref))) < 0.06
+        assert float(
+            jnp.mean(jnp.abs(out - ref))
+        ) < 0.01  # drift is rounding noise, not bias
+        pos = jnp.broadcast_to(jnp.arange(S)[None], (2, S))
+        out_pos = apply_rope(
+            x, cos, sin, positions=pos, compute_dtype=jnp.bfloat16
+        ).astype(jnp.float32)
+        assert jnp.allclose(out_pos, out, atol=1e-6)
+        assert jnp.allclose(
+            jnp.linalg.norm(x.astype(jnp.float32), axis=-1),
+            jnp.linalg.norm(out, axis=-1),
+            rtol=0.05,
+        )
+
 
 class TestSwiGLU:
     def test_shape_and_grad(self, rng):

@@ -310,14 +310,61 @@ class TestDispatchModes:
             np.asarray(gr_k), np.asarray(gr_r), atol=1e-4, rtol=1e-4
         )
 
-    def test_gmm_path_masks_kernel_garbage(self, monkeypatch):
+    @pytest.mark.parametrize("seq", [64, 50])
+    def test_gmm_tile_padding_matches_sort(self, seq):
+        """Arbitrary (non-multiple-of-128) row counts run dropless via
+        tile padding: S=50 gives N = 2·50·2 = 200 pair rows, padded to
+        256 — outputs, input grads AND param grads must match the sort
+        path bit-for-bit-at-tolerance, and routing stats exactly
+        (VERDICT r5 #6: this shape used to raise the 128-row fence)."""
+        import dataclasses
+
+        x = jax.random.normal(jax.random.PRNGKey(7), (2, seq, 64))
+        results = {}
+        for mode in ("sort", "gmm"):
+            cfg = dataclasses.replace(
+                moe_config(routing_noise_std=0.0), moe_dispatch=mode
+            )
+            layer = MoELayer(cfg, dtype=jnp.float32)
+            params = layer.init(jax.random.PRNGKey(0), x)
+
+            def loss(p, xx):
+                out, m = layer.apply(p, xx)
+                return jnp.sum(out**2), (out, m)
+
+            (_, (out, m)), grads = jax.value_and_grad(
+                loss, argnums=(0, 1), has_aux=True
+            )(params, x)
+            results[mode] = (out, m, grads)
+        out_s, m_s, g_s = results["sort"]
+        out_g, m_g, g_g = results["gmm"]
+        np.testing.assert_allclose(
+            np.asarray(out_g), np.asarray(out_s), atol=1e-5, rtol=1e-5
+        )
+        assert float(m_g["moe_drop_rate"]) == pytest.approx(
+            float(m_s["moe_drop_rate"]), abs=1e-6
+        )
+        for (ka, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(g_s),
+            jax.tree_util.tree_leaves_with_path(g_g),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4,
+                err_msg=f"grad mismatch at {ka} (seq={seq})",
+            )
+
+    @pytest.mark.parametrize("seq", [64, 50])
+    def test_gmm_path_masks_kernel_garbage(self, monkeypatch, seq):
         """Pin that _gmm_path ITSELF masks the kernel's uninitialized
         tail (not just that masking-as-a-pattern works): inject a gmm
         whose forward writes NaN into rows past sum(group_sizes) and
         whose custom-VJP backward writes NaN into the same grad_lhs rows
         — exactly the real megablox contract on TPU. With the operand
         masks in place, layer output and input grads must stay finite
-        and match the sort path; without them, this test goes NaN."""
+        and match the sort path; without them, this test goes NaN.
+        seq=50 additionally covers the TILE-PADDED tail (N=200 → 256):
+        pad rows are NaN in the injected kernel too, so a padding row
+        leaking into output or grads fails here."""
         import dataclasses
 
         from luminaai_tpu.models import moe as moe_mod
@@ -365,7 +412,7 @@ class TestDispatchModes:
             return core(lhs, rhs, group_sizes.astype(jnp.float32))
 
         monkeypatch.setattr(moe_mod, "_GMM_OVERRIDE", nan_tail_gmm)
-        x = jax.random.normal(jax.random.PRNGKey(5), (2, 64, 64))
+        x = jax.random.normal(jax.random.PRNGKey(5), (2, seq, 64))
         cfg = dataclasses.replace(
             moe_config(routing_noise_std=0.0),
             moe_dispatch="gmm",

@@ -87,6 +87,12 @@ GMM_MESHES = {
     "dp8": {},
     "dp4_ep2": dict(expert_parallel_size=2),
     "dp2_fsdp2_ep2": dict(fsdp_parallel_size=2, expert_parallel_size=2),
+    # r6: the tensor axis composes — gate/up column-parallel + wo
+    # row-parallel inside the shard_map body, psum over (expert, tensor).
+    "dp4_tp2": dict(tensor_parallel_size=2),
+    "dp2_tp2_ep2": dict(
+        tensor_parallel_size=2, expert_parallel_size=2
+    ),
 }
 
 
@@ -140,13 +146,47 @@ def test_gmm_dispatch_on_mesh_matches_gather(mesh_kw, monkeypatch):
         assert abs(da - db) < 1e-6, (mesh_kw, losses)
 
 
-def test_gmm_rejects_tensor_mesh():
-    """gmm composes with data/fsdp/expert only; tensor/sequence/pipe are
-    rejected at config validation."""
+def test_gmm_tile_padding_on_mesh_matches_gather():
+    """Non-multiple-of-128 per-shard row counts run dropless on a mesh:
+    seq 40 gives 1·40·2 = 80 pair rows per dp8 shard (padded to 128) —
+    the shape the r5 fence rejected. One train step must match gather."""
+    losses = {}
+    for disp in ("gather", "gmm"):
+        cfg = tiny_config(
+            use_moe=True, num_experts=8, moe_pattern="all",
+            routing_noise_std=0.0, moe_dispatch=disp, seq_length=40,
+        )
+        _, metrics, _ = run_one_step(cfg)
+        losses[disp] = (
+            float(metrics["ce_loss"]), float(metrics["moe_drop_rate"])
+        )
+    assert abs(losses["gather"][0] - losses["gmm"][0]) < 2e-3, losses
+    assert abs(losses["gather"][1] - losses["gmm"][1]) < 1e-6, losses
+
+
+def test_gmm_rejects_sequence_mesh():
+    """gmm composes with data/fsdp/expert/tensor; sequence/pipe would
+    split the kernel's sorted row dimension and are rejected at config
+    validation."""
     with pytest.raises(AssertionError, match="gmm"):
         tiny_config(
             use_moe=True, num_experts=8, moe_dispatch="gmm",
-            tensor_parallel_size=2,
+            sequence_parallel_size=2, use_ring_attention=True,
+        )
+
+
+def test_gmm_accepts_tensor_mesh():
+    """tensor no longer rejected (r6) — but intermediate_size must split
+    evenly over the tensor shards."""
+    cfg = tiny_config(
+        use_moe=True, num_experts=8, moe_dispatch="gmm",
+        tensor_parallel_size=2,
+    )
+    assert cfg.moe_dispatch == "gmm"
+    with pytest.raises(AssertionError, match="intermediate_size"):
+        tiny_config(
+            use_moe=True, num_experts=8, moe_dispatch="gmm",
+            tensor_parallel_size=2, intermediate_size=129,
         )
 
 
