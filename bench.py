@@ -475,6 +475,18 @@ def _child_main(name: str) -> None:
                 "available": False,
                 "reason": "child budget exhausted before dispatch A/B",
             }
+        # Static recompile surface (ROADMAP item 5's baseline number):
+        # distinct abstract step signatures per program, enumerated
+        # without executing anything (analysis/jaxpr_audit.py). Budget-
+        # guarded like the A/B above — the enumeration traces 8 step
+        # variants and must degrade, not kill, a tight child.
+        if not budget or time.perf_counter() - child_t0 < 0.75 * budget:
+            ex["recompile_surface"] = _smoke_recompile_surface(registry)
+        else:
+            ex["recompile_surface"] = {
+                "available": False,
+                "reason": "child budget exhausted before surface audit",
+            }
         from luminaai_tpu.training.optimizer import describe_optimizer_memory
 
         ex["optimizer_memory"] = describe_optimizer_memory(state.opt_state)
@@ -1304,6 +1316,42 @@ def _smoke_dispatch_flops(registry=None) -> dict:
         return {"available": False, "reason": f"{type(e).__name__}: {e}"}
 
 
+def _smoke_recompile_surface(registry=None) -> dict:
+    """Static recompile-surface report for the smoke artifact (--smoke
+    only): distinct abstract train/decode step signatures across the
+    config variants the codebase forks on (scan on/off, gmm vs capacity
+    einsum, prefill buckets, scalar vs batched cache_index decode).
+    Abstract enumeration — jax.make_jaxpr over ShapeDtypeStructs, no
+    buffers, nothing executes — so the number is a property of the
+    code, not the run. tests/test_analysis.py pins the same counts;
+    the ROADMAP-item-5 unified-forward refactor drives them down."""
+    try:
+        from luminaai_tpu.analysis.jaxpr_audit import (
+            enumerate_recompile_surface,
+        )
+
+        surface = enumerate_recompile_surface(registry=registry)
+        return {
+            "available": True,
+            "total_variants": surface["total_variants"],
+            "total_distinct": surface["total_distinct"],
+            "host_transfer_ops": surface["host_transfer_ops"],
+            "programs": {
+                prog: {
+                    "distinct_signatures": rec["distinct_signatures"],
+                    "variants": {
+                        v["variant"]: v["signature"]
+                        for v in rec["variants"]
+                    },
+                }
+                for prog, rec in surface["programs"].items()
+            },
+            "note": surface["note"],
+        }
+    except Exception as e:
+        return {"available": False, "reason": f"{type(e).__name__}: {e}"}
+
+
 def _smoke_decode_cost(cfg, model, params, registry) -> dict:
     """Compiled-cost accounting for the continuous-batching DECODE step
     (--smoke only): builds a StepwiseDecoder over the smoke model and
@@ -1313,25 +1361,12 @@ def _smoke_decode_cost(cfg, model, params, registry) -> dict:
     try:
         import dataclasses
 
+        from luminaai_tpu.analysis.jaxpr_audit import _AuditTokenizer
         from luminaai_tpu.inference.generate import GenerationEngine
         from luminaai_tpu.monitoring.attribution import compiled_cost_metrics
 
-        class _Tok:  # minimal engine contract; no tokenizer data needed
-            eos_token_id = 1
-            pad_token_id = 0
-            im_end = 2
-
-            class backend:
-                @staticmethod
-                def encode(text):
-                    return [3 + (ord(c) % 200) for c in text]
-
-            @staticmethod
-            def decode(tokens):
-                return " ".join(str(t) for t in tokens)
-
         dcfg = dataclasses.replace(cfg, max_new_tokens=8)
-        engine = GenerationEngine(model, params, _Tok(), dcfg)
+        engine = GenerationEngine(model, params, _AuditTokenizer(), dcfg)
         decoder = engine.make_stepwise(num_slots=2, page_size=64)
         decoder.prefill_into_slot(0, [5, 6, 7, 8], max_new_tokens=4, seed=0)
         fn, args = decoder.step_fn_and_args()

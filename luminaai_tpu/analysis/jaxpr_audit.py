@@ -1,0 +1,527 @@
+"""Abstract-eval auditors: recompile surface, sharding coverage, host ops.
+
+Everything here works on ABSTRACT values — `jax.eval_shape` /
+`jax.make_jaxpr` over ShapeDtypeStructs — so no parameter buffer
+materializes and no step executes on a device (the one concrete
+allocation is the stepwise decoder's zero-filled micro KV pool, KBs at
+audit_config sizes). That makes the audits cheap enough to run as a
+blocking CI step and honest enough to pin in tests: the numbers
+describe the traced program, not a lucky run.
+
+Three auditors:
+
+- `enumerate_recompile_surface` traces the train step and the decode
+  steps across the config variants the codebase actually forks on
+  (scan_layers on/off, gmm vs capacity einsum dispatch, prefill
+  prompt buckets, scalar-offset vs batched `cache_index` decode) and
+  hashes each variant's jaxpr. The distinct-signature count is the
+  number of executables XLA must compile to serve those scenarios —
+  the number ROADMAP item 5's unified-forward refactor exists to
+  drive down. `train_recompiles_total` counts the symptom at runtime;
+  this enumerates the cause ahead of time.
+
+- `audit_sharding_coverage` walks the abstract boxed param tree and
+  flags leaves that carry no logical PartitionSpec annotation
+  (GSPMD "annotate, don't fork": an unannotated leaf silently
+  replicates and gets whatever layout XLA guesses). Same
+  flag-and-export contract as monitoring/attribution.donation_audit.
+
+- `detect_host_transfers` scans a traced jaxpr (recursively, through
+  pjit/scan/while/cond sub-jaxprs) for callback/transfer primitives —
+  the in-jaxpr counterpart of astlint's LX002 source rule.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Any, Dict, List, Sequence, Tuple
+
+__all__ = [
+    "audit_config",
+    "enumerate_recompile_surface",
+    "audit_sharding_coverage",
+    "detect_host_transfers",
+    "jaxpr_signature",
+]
+
+
+# Primitives whose presence in a hot-path jaxpr means the step talks to
+# the host mid-executable. debug_callback covers jax.debug.print.
+HOST_TRANSFER_PRIMITIVES = frozenset(
+    {
+        "pure_callback",
+        "io_callback",
+        "debug_callback",
+        "callback",
+        "outside_call",
+        "host_callback_call",
+        "infeed",
+        "outfeed",
+    }
+)
+
+
+def audit_config(**overrides):
+    """Micro config for the auditors: every code-path discriminator the
+    enumerator forks on (MoE dispatch, scan, GQA heads) is live, every
+    size knob is minimal so traces stay fast. Shapes don't matter for
+    the variant COUNT — only which paths exist."""
+    import dataclasses as _dc
+
+    from luminaai_tpu.config import ConfigPresets
+
+    cfg = ConfigPresets.debug()
+    cfg = _dc.replace(
+        cfg,
+        vocab_size=256,
+        hidden_size=64,
+        num_layers=2,
+        num_heads=2,
+        num_kv_heads=1,
+        seq_length=64,
+        intermediate_size=128,
+        batch_size=2,
+        micro_batch_size=None,
+        gradient_accumulation_steps=1,
+        num_experts=4,
+        moe_top_k=2,
+        data_parallel_size=1,
+        use_flash_attention=False,
+        routing_noise_std=0.0,
+        **overrides,
+    )
+    cfg.normalize_parallelism()
+    return cfg
+
+
+# --------------------------------------------------------------------------
+# jaxpr plumbing
+# --------------------------------------------------------------------------
+
+
+def _iter_sub_jaxprs(params: Dict[str, Any]):
+    from jax.core import ClosedJaxpr, Jaxpr
+
+    for value in params.values():
+        stack = [value]
+        while stack:
+            v = stack.pop()
+            if isinstance(v, (ClosedJaxpr, Jaxpr)):
+                yield v
+            elif isinstance(v, (list, tuple)):
+                stack.extend(v)
+
+
+def detect_host_transfers(closed_jaxpr) -> Dict[str, int]:
+    """Count host-transfer primitives in a jaxpr, recursing through
+    pjit/scan/while/cond/custom_vjp sub-jaxprs. {} means clean."""
+    counts: Dict[str, int] = {}
+    stack = [closed_jaxpr]
+    seen: set = set()
+    while stack:
+        j = stack.pop()
+        inner = getattr(j, "jaxpr", j)  # ClosedJaxpr -> Jaxpr
+        if id(inner) in seen:
+            continue
+        seen.add(id(inner))
+        for eqn in inner.eqns:
+            name = eqn.primitive.name
+            if name in HOST_TRANSFER_PRIMITIVES:
+                counts[name] = counts.get(name, 0) + 1
+            stack.extend(_iter_sub_jaxprs(eqn.params))
+    return counts
+
+
+def _aval_str(tree) -> str:
+    import jax
+
+    leaves = jax.tree.leaves(
+        tree, is_leaf=lambda x: hasattr(x, "shape") and hasattr(x, "dtype")
+    )
+    parts = []
+    for leaf in leaves:
+        shape = tuple(getattr(leaf, "shape", ()))
+        dtype = getattr(leaf, "dtype", None)
+        parts.append(f"{dtype}{list(shape)}")
+    return ";".join(parts)
+
+
+def jaxpr_signature(fn, *args, program: str, variant: str) -> Dict[str, Any]:
+    """Trace `fn(*args)` abstractly and fingerprint the executable it
+    would compile to: sha256 over the canonical jaxpr text (shapes,
+    dtypes AND ops — two variants merge only when XLA would genuinely
+    compile the same program), plus the in/out aval signature and the
+    host-transfer census from the same single trace."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*args)
+    text = str(closed)
+    return {
+        "program": program,
+        "variant": variant,
+        "signature": hashlib.sha256(text.encode()).hexdigest()[:16],
+        "in_avals": _aval_str(closed.in_avals),
+        "out_avals": _aval_str(closed.out_avals),
+        "jaxpr_eqns": len(closed.jaxpr.eqns),
+        "host_transfer_ops": detect_host_transfers(closed),
+    }
+
+
+# --------------------------------------------------------------------------
+# recompile-surface enumerator
+# --------------------------------------------------------------------------
+
+
+def _train_variants(cfg) -> List[Dict[str, Any]]:
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from luminaai_tpu.models.transformer import LuminaTransformer
+    from luminaai_tpu.parallel.mesh import build_mesh
+    from luminaai_tpu.parallel.sharding import make_init_fn, state_shardings
+    from luminaai_tpu.parallel.train_step import make_train_step
+    from luminaai_tpu.training.optimizer import make_optimizer, make_schedule
+
+    out = []
+    for scan in (False, True):
+        for dispatch in ("einsum", "gmm"):
+            vcfg = _dc.replace(
+                cfg, scan_layers=scan, moe_dispatch=dispatch
+            )
+            model = LuminaTransformer(vcfg)
+            schedule = make_schedule(vcfg, 100)
+            tx = make_optimizer(vcfg, 100, schedule)
+            mesh = build_mesh(vcfg, jax.devices()[:1])
+            shardings = state_shardings(vcfg, model, tx, mesh)
+            abstract_state = jax.eval_shape(
+                make_init_fn(vcfg, model, tx), jax.random.key(0)
+            )
+            step = make_train_step(vcfg, model, shardings, mesh, schedule, tx)
+            batch = {
+                "input_ids": jax.ShapeDtypeStruct(
+                    (vcfg.batch_size, vcfg.seq_length), jnp.int32
+                )
+            }
+            out.append(
+                jaxpr_signature(
+                    step.jitted,
+                    abstract_state,
+                    batch,
+                    program="train",
+                    variant=f"scan={'on' if scan else 'off'}/{dispatch}",
+                )
+            )
+    return out
+
+
+class _AuditTokenizer:
+    """Minimal tokenizer contract for GenerationEngine; never decodes."""
+
+    eos_token_id = 1
+    pad_token_id = 0
+    im_end = 2
+
+    class backend:
+        @staticmethod
+        def encode(text):
+            return [3]
+
+    @staticmethod
+    def decode(tokens):
+        return " ".join(str(t) for t in tokens)
+
+
+_DECODE_PREFILL_BUCKETS = (32, 64)
+
+
+def _decode_variants(cfg) -> List[Dict[str, Any]]:
+    import jax
+    import jax.numpy as jnp
+
+    from luminaai_tpu.inference.generate import (
+        GREEDY_SAMPLE_KEY,
+        GenerationEngine,
+    )
+    from luminaai_tpu.models.transformer import LuminaTransformer
+
+    model = LuminaTransformer(cfg)
+    # Abstract params end to end: the engine only ever threads them
+    # through as the first argument of the functions we trace, so
+    # ShapeDtypeStructs suffice — no init forward runs. The only real
+    # buffers below are the stepwise decoder's zero-filled micro KV
+    # pool (KBs at audit_config sizes).
+    pabs = jax.eval_shape(
+        lambda k: model.init(k, jnp.ones((1, 8), jnp.int32)),
+        jax.random.key(0),
+    )["params"]
+    engine = GenerationEngine(model, pabs, _AuditTokenizer(), cfg)
+    out = []
+
+    # Prompt-bucketed prefill: ONE executable per bucket — the surface
+    # scales with the bucket ladder, which is why it is enumerated, not
+    # assumed.
+    for bucket in _DECODE_PREFILL_BUCKETS:
+        out.append(
+            jaxpr_signature(
+                engine._make_prefill_fn(bucket),
+                pabs,
+                jax.ShapeDtypeStruct((1, bucket), jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.int32),
+                program="decode",
+                variant=f"prefill/bucket={bucket}",
+            )
+        )
+
+    # Scalar-offset decode: the single-sequence while-loop body
+    # (cache_index is a scalar start offset).
+    gen_key = (8,) + GREEDY_SAMPLE_KEY
+    caches = jax.eval_shape(lambda: model.init_cache(1, cfg.seq_length))
+    out.append(
+        jaxpr_signature(
+            engine._make_decode(gen_key),
+            pabs,
+            jax.random.key(0),
+            jax.ShapeDtypeStruct((), jnp.int32),
+            caches,
+            jax.ShapeDtypeStruct((cfg.vocab_size,), jnp.int32),
+            jax.ShapeDtypeStruct((), jnp.int32),
+            jax.ShapeDtypeStruct((), jnp.bool_),
+            program="decode",
+            variant="decode/scalar_offset",
+        )
+    )
+
+    # Batched cache_index decode: the continuous-batching step over the
+    # slot-paged pool (cache_index is a [slots] vector).
+    decoder = engine.make_stepwise(num_slots=2, page_size=16)
+    fn, args = decoder.step_fn_and_args()
+    abstract_args = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(
+            getattr(x, "shape", ()), getattr(x, "dtype", None)
+        ),
+        args,
+    )
+    out.append(
+        jaxpr_signature(
+            fn,
+            *abstract_args,
+            program="decode",
+            variant="decode/batched_cache_index",
+        )
+    )
+    return out
+
+
+def enumerate_recompile_surface(
+    cfg=None,
+    programs: Sequence[str] = ("train", "decode"),
+    registry=None,
+) -> Dict[str, Any]:
+    """Trace every config variant of the train/decode steps and report
+    the distinct-executable count per program.
+
+    Returns {"programs": {name: {"variants": [...], "distinct_signatures":
+    N}}, "total_variants": V, "total_distinct": D, "host_transfer_ops":
+    {...}}. D is the pinned baseline number the ROADMAP-item-5 refactor
+    drives down; host_transfer_ops aggregates the callback census across
+    every enumerated executable (expected empty)."""
+    cfg = cfg or audit_config()
+    per_program: Dict[str, Any] = {}
+    transfers: Dict[str, int] = {}
+    total_variants = 0
+    all_signatures: set = set()
+    for program in programs:
+        if program == "train":
+            variants = _train_variants(cfg)
+        elif program == "decode":
+            variants = _decode_variants(cfg)
+        else:
+            raise ValueError(f"unknown program {program!r}")
+        signatures = {v["signature"] for v in variants}
+        all_signatures |= signatures
+        total_variants += len(variants)
+        for v in variants:
+            for prim, n in v["host_transfer_ops"].items():
+                transfers[prim] = transfers.get(prim, 0) + n
+        per_program[program] = {
+            "variants": variants,
+            "distinct_signatures": len(signatures),
+        }
+    out = {
+        "programs": per_program,
+        "total_variants": total_variants,
+        "total_distinct": len(all_signatures),
+        "host_transfer_ops": transfers,
+        "note": (
+            "abstract enumeration (nothing executed): distinct jaxpr "
+            "signatures per program = executables XLA must compile to "
+            "cover the enumerated scenarios; ROADMAP item 5 drives "
+            "this down"
+        ),
+    }
+    _export_surface_gauges(out, registry)
+    return out
+
+
+def _export_surface_gauges(out: Dict[str, Any], registry) -> None:
+    from luminaai_tpu.monitoring.telemetry import get_registry
+
+    registry = registry or get_registry()
+    g = registry.gauge(
+        "analysis_recompile_surface",
+        "Distinct abstract step signatures per program at last audit "
+        "(static counterpart of train_recompiles_total)",
+        labelnames=("program",),
+    )
+    for program, rec in out["programs"].items():
+        g.labels(program=program).set(float(rec["distinct_signatures"]))
+    registry.gauge(
+        "analysis_host_transfer_ops",
+        "Host callback/transfer primitives found inside enumerated hot-"
+        "path jaxprs at last audit (expected 0)",
+    ).set(float(sum(out["host_transfer_ops"].values())))
+
+
+# --------------------------------------------------------------------------
+# sharding-coverage auditor
+# --------------------------------------------------------------------------
+
+
+def audit_sharding_coverage(
+    cfg=None, registry=None
+) -> Dict[str, Any]:
+    """Walk the abstract boxed param tree and flag leaves with no
+    explicit PartitionSpec (nn.Partitioned names). Same contract as
+    donation_audit: flags and exports gauges, never raises."""
+    import flax.linen as nn
+
+    from luminaai_tpu.models.transformer import LuminaTransformer
+    from luminaai_tpu.monitoring.telemetry import get_registry
+    from luminaai_tpu.parallel.sharding import _abstract_boxed_params
+
+    cfg = cfg or audit_config()
+    model = LuminaTransformer(cfg)
+    boxed = _abstract_boxed_params(cfg, model)
+
+    annotated = 0
+    flagged: List[Dict[str, Any]] = []
+
+    def walk(tree, path: Tuple[str, ...]) -> None:
+        nonlocal annotated
+        if isinstance(tree, nn.Partitioned):
+            annotated += 1
+            return
+        if isinstance(tree, dict):
+            for k in sorted(tree):
+                walk(tree[k], path + (str(k),))
+            return
+        if hasattr(tree, "shape"):
+            flagged.append(
+                {
+                    "path": "/".join(path),
+                    "shape": list(getattr(tree, "shape", ())),
+                    "dtype": str(getattr(tree, "dtype", "?")),
+                }
+            )
+            return
+        items = getattr(tree, "items", None)
+        if callable(items):
+            for k, v in sorted(items()):
+                walk(v, path + (str(k),))
+
+    walk(boxed, ())
+    total = annotated + len(flagged)
+    out: Dict[str, Any] = {
+        "total_leaves": total,
+        "annotated_leaves": annotated,
+        "unannotated_leaves": len(flagged),
+        "coverage": round(annotated / total, 4) if total else None,
+        "flagged": flagged[:50],
+        "note": (
+            "GSPMD 'annotate, don't fork': a param leaf with no logical "
+            "PartitionSpec replicates silently and takes whatever "
+            "layout XLA guesses"
+        ),
+    }
+    registry = registry or get_registry()
+    if out["coverage"] is not None:
+        registry.gauge(
+            "sharding_annotation_coverage",
+            "Fraction of param leaves carrying an explicit logical "
+            "PartitionSpec at last audit (1.0 = fully annotated)",
+        ).set(out["coverage"])
+    registry.gauge(
+        "sharding_unannotated_leaves",
+        "Param leaves with no explicit PartitionSpec at last audit",
+    ).set(float(len(flagged)))
+    return out
+
+
+# --------------------------------------------------------------------------
+# combined entry point (what `lumina analyze` calls)
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class AuditVerdict:
+    """One auditor's pass/fail plus its full report."""
+
+    name: str
+    ok: bool
+    detail: Dict[str, Any]
+
+
+def run_audits(
+    cfg=None, registry=None, programs: Sequence[str] = ("train", "decode")
+) -> Tuple[List[AuditVerdict], Dict[str, Any]]:
+    """Run the abstract auditors; the boolean verdicts drive the
+    `lumina analyze` exit code, the report dict rides in --json."""
+    cfg = cfg or audit_config()
+    verdicts: List[AuditVerdict] = []
+
+    try:
+        surface = enumerate_recompile_surface(
+            cfg, programs=programs, registry=registry
+        )
+        # The surface count itself is informational (the refactor
+        # baseline); host transfers inside the enumerated hot paths
+        # are a failure.
+        verdicts.append(
+            AuditVerdict(
+                "host_transfers",
+                ok=not surface["host_transfer_ops"],
+                detail={"host_transfer_ops": surface["host_transfer_ops"]},
+            )
+        )
+    except Exception as e:  # never wedge the gate on an audit crash...
+        surface = {"error": f"{type(e).__name__}: {e}"}
+        # ...but a crash is a FAILURE: an unenumerable surface means
+        # the audit lost its subject, not that the repo is clean.
+        verdicts.append(
+            AuditVerdict("host_transfers", ok=False, detail=surface)
+        )
+
+    try:
+        coverage = audit_sharding_coverage(cfg, registry=registry)
+        verdicts.append(
+            AuditVerdict(
+                "sharding_coverage",
+                ok=coverage["unannotated_leaves"] == 0,
+                detail={
+                    "coverage": coverage["coverage"],
+                    "unannotated_leaves": coverage["unannotated_leaves"],
+                    "flagged": coverage["flagged"],
+                },
+            )
+        )
+    except Exception as e:
+        coverage = {"error": f"{type(e).__name__}: {e}"}
+        verdicts.append(
+            AuditVerdict("sharding_coverage", ok=False, detail=coverage)
+        )
+
+    report = {"recompile_surface": surface, "sharding_coverage": coverage}
+    return verdicts, report

@@ -16,7 +16,6 @@ import time
 from pathlib import Path
 from typing import Any, Dict, List, Optional, Tuple
 
-import numpy as np
 
 from luminaai_tpu.config import Config
 from luminaai_tpu.data.tokenizer import ConversationTokenizer

@@ -1109,7 +1109,12 @@ class StepwiseDecoder:
                 )
                 return self._paged(flat), nxt, eos, counts, new_rngs
 
-            self._fns[key] = jax.jit(step)
+            # No donation, deliberately: the scheduler catches a failed
+            # step (transient XlaRuntimeError), fails the active lanes,
+            # and keeps serving from the SAME pool — donating the cache
+            # operand would delete pool.caches on the failed call and
+            # turn one transient error into permanent dead buffers.
+            self._fns[key] = jax.jit(step)  # lumina: disable=LX006 -- pool must survive failed steps; see comment above
         return self._fns[key]
 
     # -- scheduler-facing API ----------------------------------------------

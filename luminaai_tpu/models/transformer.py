@@ -11,7 +11,6 @@ updated with `lax.dynamic_update_slice`.
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax

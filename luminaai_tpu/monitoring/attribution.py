@@ -42,7 +42,7 @@ import math
 import os
 import re
 from dataclasses import dataclass, field
-from typing import Any, Dict, Iterable, List, Mapping, Optional, Sequence
+from typing import Any, Dict, Iterable, List, Mapping, Optional
 
 from luminaai_tpu.monitoring.telemetry import MetricsRegistry, get_registry
 

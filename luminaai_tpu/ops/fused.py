@@ -15,7 +15,6 @@ from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import optax
 
 
 def cross_entropy_loss(

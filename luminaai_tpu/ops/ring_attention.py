@@ -30,7 +30,7 @@ makes, and `ppermute` transposes to the reverse rotation automatically.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -19,14 +19,13 @@ training/training_loop.py microbatch loop). Differences, by design:
 
 from __future__ import annotations
 
-import functools
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import optax
 from flax import linen as nn
-from jax.sharding import Mesh, NamedSharding, PartitionSpec
+from jax.sharding import Mesh, NamedSharding
 
 from luminaai_tpu.config import Config
 from luminaai_tpu.ops.fused import (

@@ -198,7 +198,7 @@ def make_lora_train_step(
         merged = merge_lora(base_params, lora, spec)
         return inner(merged, batch, rng)
 
-    @jax.jit
+    @jax.jit  # lumina: disable=LX006 -- adapters are MBs not GBs; callers may keep the pre-training adapter for before/after comparison, which donation would invalidate
     def step(carry, batch, rng):
         lora, opt_state = carry
         (_, metrics), grads = jax.value_and_grad(lora_loss, has_aux=True)(
@@ -306,7 +306,7 @@ def make_prompt_tuning_step(config: Config, model, base_params, tx):
         metrics["loss"] = loss + aux.get("aux_loss", 0.0)
         return metrics["loss"], metrics
 
-    @jax.jit
+    @jax.jit  # lumina: disable=LX006 -- soft prompts are KBs; callers compare the pre-training prompt after stepping, which donation would invalidate
     def step(carry, batch):
         prompt, opt_state = carry
         (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(

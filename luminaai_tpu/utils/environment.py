@@ -363,10 +363,14 @@ def connectivity_probe(
     import jax
     import jax.numpy as jnp
     import numpy as np
-    from jax.experimental.shard_map import shard_map
     from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
     from luminaai_tpu.monitoring.telemetry import get_registry
+    # The version-compat wrapper, NOT jax.experimental.shard_map: the
+    # experimental module's signature drifted across the 0.4.x line and
+    # broke on this container's jax (astlint rule LX001 pins the wrapper
+    # as the one sanctioned entry point).
+    from luminaai_tpu.parallel.mesh import shard_map
 
     registry = registry or get_registry()
     n_proc = jax.process_count()

@@ -1,6 +1,6 @@
 # Development makefile (ref makefile:1 — its desktop dev commands; these
 # target the TPU framework's actual workflows).
-.PHONY: help install test test-fast bench bench-ops dryrun serve load docker
+.PHONY: help install test test-fast analyze lint bench bench-ops dryrun serve load docker
 
 PY ?= python
 
@@ -16,6 +16,13 @@ test-fast: ## Fast test tier (CPU, ~10 min) — what CI runs on push
 
 test: ## Full suite (includes 8-device mesh parity + e2e trains)
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q
+
+analyze: ## Static-analysis gate (astlint rules + abstract-eval audits)
+	JAX_PLATFORMS=cpu lumina analyze
+
+lint: ## Sub-second lint-only loop (no jax tracing); + ruff if installed
+	lumina analyze --no-audit
+	@if command -v ruff >/dev/null 2>&1; then ruff check .; else echo "ruff not installed; skipping (CI runs it)"; fi
 
 bench: ## Driver-contract benchmark (one JSON line)
 	$(PY) bench.py

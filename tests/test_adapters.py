@@ -1,6 +1,5 @@
 """PEFT: LoRA adapters + soft-prompt tuning (ref docs/adapters.md)."""
 
-import dataclasses
 
 import jax
 import jax.numpy as jnp

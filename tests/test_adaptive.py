@@ -3,7 +3,6 @@ runtime capacity-factor and routing-temperature tuning
 (ref trainer.py:1450,1471,1626; Main.py:292)."""
 
 import numpy as np
-import pytest
 
 import jax
 

@@ -25,7 +25,6 @@ from luminaai_tpu.data.dataset import PackedDataset, PrefetchLoader, TokenCache
 from luminaai_tpu.data.tokenizer import ConversationTokenizer
 from luminaai_tpu.models.transformer import LuminaTransformer
 from luminaai_tpu.native import pack_batch, shuffle_indices
-from luminaai_tpu.ops.fused import cross_entropy_loss
 from luminaai_tpu.parallel.train_step import make_loss_fn, shift_with_labels
 
 

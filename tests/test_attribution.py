@@ -555,7 +555,6 @@ def test_donation_audit_degrades_without_memory():
 def test_tree_bytes_counts_mixed_dtypes_and_keys():
     import jax
     import jax.numpy as jnp
-    import numpy as np
 
     tree = {
         "a": jnp.zeros((4, 4), jnp.float32),       # 64 bytes

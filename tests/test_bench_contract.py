@@ -667,6 +667,67 @@ def test_smoke_embeds_dispatch_flops_and_donation_audit():
     assert aud["available"] and aud["coverage"] > 0.9, aud
     assert aud["flagged"] is False
     assert ex["optimizer_memory"]["total_bytes"] > 0
+    rs = ex["recompile_surface"]
+    assert rs["available"], rs
+    assert rs["programs"]["train"]["distinct_signatures"] >= 1
+    assert rs["programs"]["decode"]["distinct_signatures"] >= 1
+    assert rs["host_transfer_ops"] == {}, rs
+
+
+def test_smoke_recompile_surface_embedding_contract(monkeypatch):
+    """The smoke artifact's extras.recompile_surface field: per-program
+    distinct-signature counts plus the variant->signature map, flattened
+    from the auditor's report (the full enumeration itself is pinned in
+    tests/test_analysis.py; here the WIRING is the contract)."""
+    from luminaai_tpu.analysis import jaxpr_audit
+
+    canned = {
+        "programs": {
+            "train": {
+                "distinct_signatures": 4,
+                "variants": [
+                    {"variant": "scan=off/einsum", "signature": "aa",
+                     "host_transfer_ops": {}},
+                    {"variant": "scan=off/gmm", "signature": "bb",
+                     "host_transfer_ops": {}},
+                ],
+            },
+        },
+        "total_variants": 2,
+        "total_distinct": 2,
+        "host_transfer_ops": {},
+        "note": "canned",
+    }
+    monkeypatch.setattr(
+        jaxpr_audit, "enumerate_recompile_surface",
+        lambda registry=None, **k: canned,
+    )
+    out = bench._smoke_recompile_surface()
+    assert out["available"] is True
+    assert out["total_distinct"] == 2
+    assert out["programs"]["train"]["distinct_signatures"] == 4
+    assert out["programs"]["train"]["variants"] == {
+        "scan=off/einsum": "aa", "scan=off/gmm": "bb",
+    }
+    assert out["host_transfer_ops"] == {}
+
+
+def test_smoke_recompile_surface_degrades_without_killing_child(
+    monkeypatch,
+):
+    """An auditor crash must degrade to available=False with a reason —
+    the smoke child's artifact contract (one JSON line) survives."""
+    from luminaai_tpu.analysis import jaxpr_audit
+
+    def boom(registry=None, **k):
+        raise RuntimeError("enumeration wedged")
+
+    monkeypatch.setattr(
+        jaxpr_audit, "enumerate_recompile_surface", boom
+    )
+    out = bench._smoke_recompile_surface()
+    assert out["available"] is False
+    assert "enumeration wedged" in out["reason"]
 
 
 def test_emitted_flagship_headline_does_not_self_duplicate(

@@ -11,7 +11,6 @@ from luminaai_tpu.data.dataset import (
     ConversationDataset,
     PackedDataset,
     PrefetchLoader,
-    TokenCache,
     build_text_cache,
     conversation_batches,
 )

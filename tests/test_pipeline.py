@@ -1,6 +1,5 @@
 """GPipe pipeline parallelism (parallel/pipeline.py) on the 8-dev CPU mesh."""
 
-import dataclasses
 
 import jax
 import jax.numpy as jnp

@@ -1,7 +1,5 @@
 """Weight-only quantization tests (ref trainer.py:575 QuantizationManager)."""
 
-import dataclasses
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -12,7 +10,6 @@ from luminaai_tpu.models.transformer import LuminaTransformer
 from luminaai_tpu.training.quantization import (
     QuantizationManager,
     QuantizedTensor,
-    dequantize_tree,
     quantize_array,
     quantize_tree,
 )

@@ -10,11 +10,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.sharding import Mesh, PartitionSpec
+from jax.sharding import Mesh
 
-from luminaai_tpu.config import Config
 from luminaai_tpu.ops.ring_attention import ring_attention
-from tests.test_sharding import make_batch, run_one_step, tiny_config
+from tests.test_sharding import run_one_step, tiny_config
 
 
 def reference_attention(q, k, v, causal=True, window=None):

@@ -5,7 +5,6 @@ import time
 from pathlib import Path
 
 import jax.numpy as jnp
-import numpy as np
 
 from luminaai_tpu.utils.profiling import (
     SectionTimer,
