@@ -490,6 +490,12 @@ def _child_main(name: str) -> None:
         from luminaai_tpu.training.optimizer import describe_optimizer_memory
 
         ex["optimizer_memory"] = describe_optimizer_memory(state.opt_state)
+        # Router health (docs/observability.md "Router health"): the
+        # per-expert load fractions + entropy from the measured window's
+        # LAST step — live proof the router-health aux outputs thread
+        # through the train step. Loads are normalized kept-token
+        # shares, so CI can assert they sum to ~1.0.
+        ex["router_health"] = _router_health_extras(metrics)
         # Resilience surface (docs/resilience.md): a preempt-and-resume
         # cycle must report exact data-state resume; a False here fails
         # the smoke artifact loudly (error field + exit 1).
@@ -499,6 +505,23 @@ def _child_main(name: str) -> None:
         )
         ex["resume_check"] = resume_check
         ex["bench_gate"] = _gate_verdict(result)
+        # Wide-event spine (monitoring/events.py): the bench window
+        # emits onto the process flight recorder and the artifact
+        # carries the counts by type — the resume check above already
+        # drove trainer events (train_step/preemption/recompile)
+        # through the same ring, so a zero here means the spine broke.
+        from luminaai_tpu.monitoring.events import get_recorder
+
+        _rec = get_recorder()
+        _rec.emit(
+            "bench_window", config=name, steps=steps, platform=platform,
+            tokens_per_sec_per_chip=round(tps_chip, 1),
+        )
+        ex["events"] = {
+            "counts": _rec.counts_by_type(),
+            "buffered": len(_rec),
+            "dropped": _rec.dropped,
+        }
         ex["note"] = (
             "hermetic cpu smoke: attribution + gate + resume surface "
             "check, not a performance claim"
@@ -1122,6 +1145,42 @@ def _gate_verdict(result: dict) -> dict:
         return {"verdict": "error", "reason": f"{type(e).__name__}: {e}"}
 
 
+def _router_health_extras(metrics) -> dict:
+    """MoE router-health summary from one train step's metrics dict
+    (--smoke only): normalized per-expert load (sums to ~1.0), routing
+    entropy, max-expert share. Degrades to available=False on dense
+    configs or missing aux outputs."""
+    import numpy as np
+
+    util = metrics.get("expert_utilization")
+    if util is None:
+        return {"available": False, "reason": "no expert_utilization"}
+    try:
+        util = np.asarray(util, dtype=np.float64)
+        total = float(util.sum())
+        if not np.isfinite(total) or total <= 0:
+            return {"available": False, "reason": f"bad load sum {total}"}
+        load = util / total
+
+        def scalar(key):
+            v = metrics.get(key)
+            if v is None:
+                return None
+            f = float(v)
+            return round(f, 4) if np.isfinite(f) else None
+
+        return {
+            "available": True,
+            "expert_load": [round(float(x), 4) for x in load],
+            "load_sum": round(float(load.sum()), 4),
+            "router_entropy": scalar("moe_router_entropy"),
+            "max_expert_share": scalar("moe_max_expert_share"),
+            "drop_rate": scalar("moe_drop_rate"),
+        }
+    except Exception as e:
+        return {"available": False, "reason": f"{type(e).__name__}: {e}"}
+
+
 def _smoke_resume_check() -> dict:
     """Preempt-and-resume cycle on a tiny CPU trainer (--smoke only):
     train, inject a preemption at step 3 (blocking emergency save + data
@@ -1150,7 +1209,9 @@ def _smoke_resume_check() -> dict:
                 use_moe=False, use_flash_attention=False,
                 gradient_checkpointing=False, precision="fp32",
                 max_steps=max_steps, eval_every_n_batches=10**6,
-                save_every_n_batches=10**6, health_check_interval=1000,
+                # log_every = interval//10 = 1: every step emits a
+                # train_step event, so extras.events proves the spine.
+                save_every_n_batches=10**6, health_check_interval=10,
                 output_dir=tmp, learning_rate=1e-3,
             )
 

@@ -682,6 +682,7 @@ def cmd_serve(args) -> int:
         request_timeout_s=getattr(args, "request_timeout_s", None),
         max_queue_depth=getattr(args, "max_queue_depth", 128),
         drain_grace_s=getattr(args, "drain_grace_s", 30.0),
+        flight_dir=getattr(args, "flight_dir", None),
     )
     return 0
 
@@ -1070,6 +1071,70 @@ def cmd_analyze(args) -> int:
     return exit_code
 
 
+def cmd_events(args) -> int:
+    """Query the wide-event flight recorder (docs/observability.md).
+
+    Sources, in order of preference: explicit dump files, directories
+    (the newest flightrec-*.jsonl inside each — checkpoint dirs are the
+    usual argument), or — with no paths — this process's live ring
+    buffer (mostly useful in-process / in tests). Filters: --type,
+    --grep (regex over the serialized record), --tail N. --json prints
+    one JSON record per line for piping into jq."""
+    from luminaai_tpu.monitoring.events import (
+        filter_events,
+        format_event,
+        get_recorder,
+        latest_dump,
+        read_events,
+    )
+
+    if args.grep:
+        import re
+
+        try:
+            re.compile(args.grep)
+        except re.error as e:
+            print(f"bad --grep regex {args.grep!r}: {e}", file=sys.stderr)
+            return 2
+
+    events: List[Dict[str, Any]] = []
+    sources: List[str] = []
+    for p in args.paths or []:
+        path = p
+        if os.path.isdir(p):
+            path = latest_dump(p)
+            if path is None:
+                print(f"no flightrec-*.jsonl dumps under {p}",
+                      file=sys.stderr)
+                return 2
+        if not os.path.exists(path):
+            print(f"no such dump: {path}", file=sys.stderr)
+            return 2
+        events.extend(read_events(path))
+        sources.append(path)
+    if not args.paths:
+        events = get_recorder().snapshot()
+        sources.append("<live buffer>")
+
+    total = len(events)
+    events = filter_events(
+        events, type=args.etype, grep=args.grep,
+        tail=args.tail if args.tail else None,
+    )
+    if args.json:
+        for ev in events:
+            print(json.dumps(ev, default=str))
+    else:
+        for ev in events:
+            print(format_event(ev))
+    print(
+        f"{len(events)} event(s) shown of {total} from "
+        f"{', '.join(sources)}",
+        file=sys.stderr,
+    )
+    return 0
+
+
 def cmd_presets(args) -> int:
     from luminaai_tpu.config import ConfigPresets
 
@@ -1136,6 +1201,9 @@ def _install_signal_handlers(trainer) -> None:
                 trainer.state, trainer.global_step, f"signal {sig} forced",
                 data_state=trainer._data_state(),
             )
+            # Forensics for the forced exit: the last N step/alert
+            # events ride next to the save (lumina events replays them).
+            trainer._dump_flight_record(f"signal_{sig}_forced")
             print("state saved; exiting")
         except Exception as e:
             print(f"emergency save failed: {e}")
@@ -1345,6 +1413,10 @@ def build_parser() -> argparse.ArgumentParser:
                     default=30.0,
                     help="seconds SIGTERM waits for in-flight generations "
                          "to finish before shutdown")
+    sv.add_argument("--flight-dir", dest="flight_dir",
+                    help="where drain dumps the wide-event flight record "
+                         "(flightrec-*.jsonl; default: the checkpoint "
+                         "dir, else the working dir)")
     sv.set_defaults(fn=cmd_serve)
 
     b = sub.add_parser("benchmark", help="run the bench harness")
@@ -1418,6 +1490,25 @@ def build_parser() -> argparse.ArgumentParser:
     an.add_argument("--no-audit", action="store_true",
                     help="skip the abstract-eval auditors (lint only)")
     an.set_defaults(fn=cmd_analyze)
+
+    ev = sub.add_parser(
+        "events",
+        help="query the wide-event flight recorder (flightrec-*.jsonl "
+             "dumps or the live buffer)",
+    )
+    ev.add_argument(
+        "paths", nargs="*",
+        help="dump files or directories holding flightrec-*.jsonl "
+             "(e.g. a checkpoint dir); default: the in-process buffer",
+    )
+    ev.add_argument("--tail", type=int, default=0,
+                    help="show only the last N matching events")
+    ev.add_argument("--grep", help="regex over the serialized record")
+    ev.add_argument("--type", dest="etype",
+                    help="only events of this type (e.g. request_admitted)")
+    ev.add_argument("--json", action="store_true",
+                    help="one JSON record per line (pipe into jq)")
+    ev.set_defaults(fn=cmd_events)
 
     s = sub.add_parser("presets", help="list model presets")
     s.add_argument("--json", action="store_true")

@@ -451,6 +451,12 @@ class MoELayer(nn.Module):
         p = router_probs.mean(axis=(0, 1))
         lse2 = jnp.mean(jax.nn.logsumexp(gate_logits, axis=-1) ** 2)
         drop = dropped.mean()
+        # Router health (fp32, in-jit — leaves the device only at the
+        # trainer's log-window sync): mean per-token entropy of the
+        # routing distribution. ln(E) = uniform routing; -> 0 = collapse.
+        entropy = -jnp.mean(
+            jnp.sum(router_probs * jnp.log(router_probs + 1e-9), axis=-1)
+        )
         if cfg.moe_stat_pmean_axes:
             # Token shards each saw a fraction of the batch (over 'expert'
             # when ep borrows the data dim, over 'sequence' under manual
@@ -463,6 +469,7 @@ class MoELayer(nn.Module):
             p = jax.lax.pmean(p, axes)
             lse2 = jax.lax.pmean(lse2, axes)
             drop = jax.lax.pmean(drop, axes)
+            entropy = jax.lax.pmean(entropy, axes)
         aux_loss = jnp.clip(
             jnp.sum(f * p) * E * cfg.load_balancing_weight, max=1.0
         )
@@ -472,6 +479,11 @@ class MoELayer(nn.Module):
             "moe_z_loss": z_loss,
             "moe_drop_rate": drop,
             "expert_utilization": f * E,  # 1.0 == perfectly balanced
+            "moe_router_entropy": entropy,
+            # Hottest expert's share of KEPT (token, slot) pairs: 1/E ==
+            # balanced, -> 1.0 == collapse onto one expert. Normalized by
+            # the kept mass so capacity drops don't masquerade as balance.
+            "moe_max_expert_share": jnp.max(f) / (jnp.sum(f) + 1e-9),
         }
         return out.astype(self.dtype), metrics
 

@@ -12,6 +12,11 @@ from luminaai_tpu.monitoring.attribution import (
     compiled_cost_metrics,
     export_attribution,
 )
+from luminaai_tpu.monitoring.events import (
+    FlightRecorder,
+    get_recorder,
+    set_recorder,
+)
 from luminaai_tpu.monitoring.logger import (
     MetricsCollector,
     TrainingAlert,
@@ -26,6 +31,9 @@ from luminaai_tpu.monitoring.telemetry import (
 from luminaai_tpu.monitoring.tracing import NULL_TRACER, Span, SpanTracer
 
 __all__ = [
+    "FlightRecorder",
+    "get_recorder",
+    "set_recorder",
     "MetricsCollector",
     "TrainingAlert",
     "TrainingHealthMonitor",

@@ -47,12 +47,19 @@ class MetricsCollector:
         loss_spike_threshold: float = 2.0,
         grad_norm_threshold: float = 100.0,
         registry=None,
+        recorder=None,
     ):
         self.window_size = window_size
         self.loss_spike_threshold = loss_spike_threshold
         self.grad_norm_threshold = grad_norm_threshold
         self.metrics: Dict[str, deque] = {}
         self.alerts: List[TrainingAlert] = []
+        # Event-spine unification (monitoring/events.py): alerts land on
+        # the SAME flight recorder the serving/trainer events use, so a
+        # crash dump carries the alert trail, not a parallel half-trail.
+        from luminaai_tpu.monitoring.events import get_recorder
+
+        self._recorder = recorder if recorder is not None else get_recorder()
         # Optional bridge into the unified telemetry registry
         # (monitoring/telemetry.py): alerts become a labeled counter on
         # the same /metrics surface the serving stack exports.
@@ -110,6 +117,11 @@ class MetricsCollector:
         self.alerts.append(alert)
         if self._alerts_total is not None:
             self._alerts_total.labels(severity=severity).inc()
+        self._recorder.emit(
+            "alert", severity=severity, metric=metric,
+            value=(float(value) if math.isfinite(value) else str(value)),
+            step=step, message=message,
+        )
         log = logger.critical if severity == "critical" else logger.warning
         log("[%s] step %d: %s", severity.upper(), step, message)
 
@@ -181,6 +193,7 @@ class TrainingHealthMonitor:
         health_check_interval: int = 100,
         wandb_config: Optional[Dict[str, Any]] = None,
         registry: Optional[Any] = None,
+        recorder: Optional[Any] = None,
     ):
         # Optional Weights & Biases mirror (ref enable_wandb). Degrades to
         # a warning when the package is absent (this image has no wandb);
@@ -214,10 +227,19 @@ class TrainingHealthMonitor:
             self._health_gauge.set_function(
                 weak_callback(self, lambda m: m.collector.get_health_score())
             )
+        # One structured trail, not two half-trails: every scalar logged
+        # here ALSO lands as a train_step event on the process flight
+        # recorder (monitoring/events.py), so the jsonl file (durable,
+        # full history) and the ring buffer (last-N, crash-dumpable,
+        # `lumina events`-queryable) tell the same story.
+        from luminaai_tpu.monitoring.events import get_recorder
+
+        self._recorder = recorder if recorder is not None else get_recorder()
         self.collector = MetricsCollector(
             loss_spike_threshold=loss_spike_threshold,
             grad_norm_threshold=grad_norm_threshold,
             registry=registry,
+            recorder=self._recorder,
         )
         self.health_check_interval = health_check_interval
         self.phase = "warmup"
@@ -239,7 +261,8 @@ class TrainingHealthMonitor:
                 d.mkdir(parents=True, exist_ok=True)
                 self.log_path = d / "metrics.jsonl"
 
-    def log_step(self, step: int, metrics: Dict[str, Any]) -> None:
+    def log_step(self, step: int, metrics: Dict[str, Any],
+                 event: str = "train_step") -> None:
         now = time.time()
         if self._last_log is not None and step > self._last_log[1]:
             self.step_times.append((now - self._last_log[0], step - self._last_log[1]))
@@ -254,6 +277,13 @@ class TrainingHealthMonitor:
                 continue
             scalars[k] = f
         self.collector.add_metrics(scalars, step)
+        self._recorder.emit(
+            event, step=step,
+            # Envelope keys (and `step`, bound above) can't ride as
+            # kwargs — a metric named like one would TypeError.
+            **{k: v for k, v in scalars.items()
+               if k not in ("v", "ts", "type", "seq", "step")},
+        )
         self._update_phase(step, scalars)
         if self._registry is not None:
             self._mirror_to_registry(step, scalars)

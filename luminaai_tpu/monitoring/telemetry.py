@@ -47,6 +47,9 @@ __all__ = [
     "get_registry",
     "set_registry",
     "DEFAULT_LATENCY_BUCKETS",
+    "DEFAULT_MAX_LABEL_VALUES",
+    "MAX_LABEL_VALUE_LEN",
+    "OVERFLOW_LABEL",
 ]
 
 # Latency buckets in SECONDS, spanning sub-ms token steps on TPU up to
@@ -58,6 +61,17 @@ DEFAULT_LATENCY_BUCKETS: Tuple[float, ...] = (
 )
 
 _RESERVED_SUFFIXES = ("_bucket", "_sum", "_count")
+
+# Label hardening: exposition size is label-cardinality × families, and
+# label VALUES often come from the outside world (tenant hashes, routes).
+# Every labeled family therefore clamps: values longer than
+# MAX_LABEL_VALUE_LEN truncate, and once a label has minted
+# max_label_values distinct values, new ones collapse into the
+# OVERFLOW_LABEL bucket — a hostile client can cost one extra series,
+# never an unbounded /metrics.
+MAX_LABEL_VALUE_LEN = 64
+DEFAULT_MAX_LABEL_VALUES = 100
+OVERFLOW_LABEL = "_overflow"
 
 
 def _fmt(v: float) -> str:
@@ -265,14 +279,22 @@ class _Family:
     Unlabeled families proxy child methods directly, so the common case
     stays `registry.counter("x", "help").inc()`."""
 
-    def __init__(self, name, help_text, typ, labelnames, lock, **kw):
+    def __init__(self, name, help_text, typ, labelnames, lock,
+                 max_label_values: Optional[int] = None, **kw):
         self.name = name
         self.help = help_text
         self.type = typ
         self.labelnames = tuple(labelnames or ())
+        self.max_label_values = int(
+            max_label_values or DEFAULT_MAX_LABEL_VALUES
+        )
         self._lock = lock
         self._kw = kw
         self._children: Dict[Tuple[str, ...], _Child] = {}
+        # Distinct values minted per label name (the cardinality budget).
+        self._label_values: Dict[str, set] = {
+            k: set() for k in self.labelnames
+        }
         if not self.labelnames:
             self._children[()] = self._make({})
 
@@ -288,13 +310,32 @@ class _Family:
                 f"{self.name} takes labels {self.labelnames}, got "
                 f"{tuple(labels)}"
             )
-        key = tuple(str(labels[k]) for k in self.labelnames)
         with self._lock:
+            key = tuple(
+                self._clamp_value(k, str(labels[k]))
+                for k in self.labelnames
+            )
             child = self._children.get(key)
             if child is None:
                 child = self._make(dict(zip(self.labelnames, key)))
                 self._children[key] = child
         return child
+
+    def _clamp_value(self, labelname: str, value: str) -> str:
+        """Bounded-cardinality guard (call under self._lock): length-cap
+        the value, then charge it against the label's distinct-value
+        budget — an exhausted budget routes NEW values into the
+        `_overflow` series instead of minting one. Already-seen values
+        (and `_overflow` itself) always resolve to their live child, so
+        established series keep accumulating."""
+        if len(value) > MAX_LABEL_VALUE_LEN:
+            value = value[:MAX_LABEL_VALUE_LEN]
+        seen = self._label_values[labelname]
+        if value not in seen and value != OVERFLOW_LABEL:
+            if len(seen) >= self.max_label_values:
+                return OVERFLOW_LABEL
+            seen.add(value)
+        return value
 
     def children(self) -> List[_Child]:
         with self._lock:
@@ -360,7 +401,8 @@ class MetricsRegistry:
         self._lock = threading.RLock()
         self._families: Dict[str, _Family] = {}
 
-    def _get_or_create(self, name, help_text, typ, labelnames, **kw) -> _Family:
+    def _get_or_create(self, name, help_text, typ, labelnames,
+                       max_label_values=None, **kw) -> _Family:
         if not name or not name.replace("_", "a").replace(":", "a").isalnum():
             raise ValueError(f"bad metric name {name!r}")
         if typ != "histogram" and name.endswith(_RESERVED_SUFFIXES):
@@ -384,16 +426,27 @@ class MetricsRegistry:
                         f"histogram {name!r} already registered with "
                         f"buckets {fam._kw['buckets']}"
                     )
-                return fam
-            fam = _Family(name, help_text, typ, labelnames, self._lock, **kw)
+                return fam  # first registration's cardinality cap stands
+            fam = _Family(
+                name, help_text, typ, labelnames, self._lock,
+                max_label_values=max_label_values, **kw,
+            )
             self._families[name] = fam
             return fam
 
-    def counter(self, name, help_text="", labelnames=()) -> _Family:
-        return self._get_or_create(name, help_text, "counter", labelnames)
+    def counter(self, name, help_text="", labelnames=(),
+                max_label_values=None) -> _Family:
+        return self._get_or_create(
+            name, help_text, "counter", labelnames,
+            max_label_values=max_label_values,
+        )
 
-    def gauge(self, name, help_text="", labelnames=()) -> _Family:
-        return self._get_or_create(name, help_text, "gauge", labelnames)
+    def gauge(self, name, help_text="", labelnames=(),
+              max_label_values=None) -> _Family:
+        return self._get_or_create(
+            name, help_text, "gauge", labelnames,
+            max_label_values=max_label_values,
+        )
 
     def histogram(
         self,
@@ -401,9 +454,11 @@ class MetricsRegistry:
         help_text="",
         buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS,
         labelnames=(),
+        max_label_values=None,
     ) -> _Family:
         return self._get_or_create(
-            name, help_text, "histogram", labelnames, buckets=tuple(buckets)
+            name, help_text, "histogram", labelnames,
+            max_label_values=max_label_values, buckets=tuple(buckets)
         )
 
     def get(self, name: str) -> Optional[_Family]:

@@ -1,7 +1,12 @@
 """Security subsystem: auth, sessions, rate limiting, input validation
 (ref: Src/Main_Scripts/security/)."""
 
-from luminaai_tpu.security.auth import SecurityManager, Session, User
+from luminaai_tpu.security.auth import (
+    SecurityManager,
+    Session,
+    User,
+    tenant_hash,
+)
 from luminaai_tpu.security.input_validator import (
     InputValidator,
     ValidationResult,
@@ -13,6 +18,7 @@ from luminaai_tpu.security.rate_limiter import (
 
 __all__ = [
     "SecurityManager",
+    "tenant_hash",
     "Session",
     "User",
     "InputValidator",

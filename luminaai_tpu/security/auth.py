@@ -26,6 +26,20 @@ PBKDF2_ITERATIONS = 600_000
 SALT_BYTES = 16
 TOKEN_BYTES = 32
 
+ANON_TENANT = "anon"
+
+
+def tenant_hash(identity: Optional[str]) -> str:
+    """Stable, non-reversible tenant label for telemetry and wide
+    events: sha256 of the authenticated identity (username / API key),
+    truncated to 12 hex chars. Raw identities must never become metric
+    labels or event fields — /metrics and flightrec dumps travel to
+    places the user database does not. None/empty (unauthenticated
+    requests) map to the shared "anon" tenant."""
+    if not identity:
+        return ANON_TENANT
+    return hashlib.sha256(str(identity).encode()).hexdigest()[:12]
+
 
 @dataclass
 class User:

@@ -52,9 +52,11 @@ import logging
 import queue
 import threading
 import time
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
+from luminaai_tpu.monitoring.events import FlightRecorder, get_recorder
 from luminaai_tpu.monitoring.telemetry import (
     DEFAULT_LATENCY_BUCKETS,
     MetricsRegistry,
@@ -62,10 +64,17 @@ from luminaai_tpu.monitoring.telemetry import (
     weak_callback,
 )
 from luminaai_tpu.monitoring.tracing import NULL_TRACER, SpanTracer
+from luminaai_tpu.security.auth import ANON_TENANT, tenant_hash
 
 logger = logging.getLogger(__name__)
 
 MAX_BODY_BYTES = 1 << 20  # 1MB request cap (input_validator also re-checks)
+
+
+def new_request_id() -> str:
+    """Per-request correlation id: short enough for log lines and SSE
+    frames, random enough to never collide within a flight record."""
+    return uuid.uuid4().hex[:12]
 
 
 class RequestTimeout(Exception):
@@ -173,12 +182,16 @@ class _ContinuousRequest:
     SSE streams, an Event + result for blocking submits)."""
 
     def __init__(self, prompt, max_new, sample_key, seed, stream,
-                 deadline=None):
+                 deadline=None, request_id=None, tenant=ANON_TENANT):
         self.prompt = list(prompt)
         self.max_new = int(max_new)
         self.sample_key = sample_key
         self.seed = seed
         self.deadline = deadline  # absolute wall time; None = no limit
+        # Identity for the wide-event trail and per-tenant accounting:
+        # every lifecycle event this request produces carries both.
+        self.request_id = request_id or new_request_id()
+        self.tenant = tenant or ANON_TENANT
         self.stream = bool(stream)
         self.sink: "queue.Queue" = queue.Queue() if stream else None
         self.event = None if stream else threading.Event()
@@ -233,6 +246,9 @@ class ContinuousScheduler:
         telemetry: bool = True,
         latency_buckets=DEFAULT_LATENCY_BUCKETS,
         request_timeout_s: Optional[float] = None,
+        recorder: Optional[FlightRecorder] = None,
+        max_tenants: int = 64,
+        tick_every: int = 16,
     ):
         self.engine = engine
         # Default per-request deadline; a request's own timeout_s can only
@@ -257,6 +273,12 @@ class ContinuousScheduler:
         # window where a request is in neither the queue nor a lane.
         self._inflight = 0
         self._inflight_lock = threading.Lock()
+        # Wide-event flight recorder (monitoring/events.py): request
+        # lifecycle events keyed by request_id + tenant. Rides the same
+        # off switch as the metrics so the overhead A/B stays honest.
+        self.recorder = recorder if recorder is not None else get_recorder()
+        self.max_tenants = max(1, int(max_tenants))
+        self.tick_every = max(1, int(tick_every))
         self._init_telemetry(registry, tracer, telemetry, latency_buckets)
         self._worker = threading.Thread(target=self._loop, daemon=True)
         self._worker.start()
@@ -314,6 +336,22 @@ class ContinuousScheduler:
             "serving_requests_timed_out_total",
             "Requests evicted (or refused admission) because their "
             "deadline passed before completion",
+        )
+        # Per-tenant accounting (bounded: max_tenants distinct labels,
+        # then the registry's `_overflow` bucket — a tenant label can
+        # never explode /metrics).
+        self._m_tenant_ttft = r.histogram(
+            "tenant_ttft_seconds",
+            "Submit-to-first-token latency per tenant",
+            buckets=buckets,
+            labelnames=("tenant",),
+            max_label_values=self.max_tenants,
+        )
+        self._m_tenant_timeouts = r.counter(
+            "tenant_requests_timed_out_total",
+            "Deadline-evicted (or admission-refused) requests per tenant",
+            labelnames=("tenant",),
+            max_label_values=self.max_tenants,
         )
         # Callback gauges hold WEAK refs: the process registry outlives
         # any one scheduler, and a strong closure would pin a replaced
@@ -425,6 +463,12 @@ class ContinuousScheduler:
 
     # -- internals ---------------------------------------------------------
     def _make_request(self, prompt_tokens, gen_kwargs, stream):
+        # Identity riders are host metadata, never compile keys: strip
+        # them before sampling-key resolution so two tenants' otherwise
+        # identical requests still share one decode executable.
+        gen_kwargs = dict(gen_kwargs)
+        request_id = gen_kwargs.pop("request_id", None)
+        tenant = gen_kwargs.pop("tenant", None)
         resolve = getattr(self.engine, "_resolve_gen_key", None)
         if resolve is not None:
             key = resolve(
@@ -461,12 +505,24 @@ class ContinuousScheduler:
             prompt_tokens, max_new, sample_key,
             gen_kwargs.get("seed"), stream,
             deadline=(time.time() + float(timeout)) if timeout else None,
+            request_id=request_id, tenant=tenant,
         )
 
     def _emit(self, req: _ContinuousRequest, token: int) -> None:
         req.tokens.append(int(token))
         if req.stream:
             req.sink.put(int(token))
+
+    def _event(self, type: str, req: Optional[_ContinuousRequest] = None,
+               **fields) -> None:
+        """Append one lifecycle event to the flight recorder, stamped
+        with the request's identity. Same off switch as the metrics."""
+        if not self.telemetry:
+            return
+        if req is not None:
+            fields.setdefault("request_id", req.request_id)
+            fields.setdefault("tenant", req.tenant)
+        self.recorder.emit(type, **fields)
 
     def _finish(self, req: _ContinuousRequest, stopped: str) -> None:
         if req.done:
@@ -483,10 +539,18 @@ class ContinuousScheduler:
             "admitted_step": req.admitted_step,
             "finished_step": int(getattr(self.decoder, "steps", 0)),
             "scheduler": "continuous",
+            "request_id": req.request_id,
+            "tenant": req.tenant,
         }
         self.requests_served += 1
         req.done = True
         self._untrack()
+        self._event(
+            "request_completed", req,
+            slot=req.slot, tokens=n, prompt_tokens=req.prompt_tokens,
+            seconds=round(dt, 3), stopped=stopped,
+            step=int(getattr(self.decoder, "steps", 0)),
+        )
         if req.stream:
             req.sink.put(stats)
         else:
@@ -498,6 +562,14 @@ class ContinuousScheduler:
             return  # terminal already delivered
         req.done = True
         self._untrack()
+        self._event(
+            "request_evicted", req,
+            slot=req.slot, tokens=len(req.tokens),
+            reason=(
+                "timeout" if isinstance(err, RequestTimeout) else "error"
+            ),
+            error=str(err)[:200],
+        )
         if req.stream:
             req.sink.put(err)
         else:
@@ -510,6 +582,7 @@ class ContinuousScheduler:
         client gets an explicit timeout instead of an open-ended wait."""
         if self.telemetry:
             self._m_timeouts.inc()
+            self._m_tenant_timeouts.labels(tenant=req.tenant).inc()
         waited = time.time() - req.t0
         self._fail(req, RequestTimeout(
             f"deadline exceeded after {waited:.1f}s ({where}; "
@@ -543,11 +616,18 @@ class ContinuousScheduler:
             return
         slot = self.decoder.acquire_slot()
         t_admit = time.perf_counter()
+        queue_wait = max(0.0, time.time() - req.t0)
         if self.telemetry:
             # Queue wait = submit to slot acquisition: covers both slot
             # contention and sampling-key parking.
-            self._m_queue_wait.observe(max(0.0, time.time() - req.t0))
+            self._m_queue_wait.observe(queue_wait)
             self._m_admissions.inc()
+        self._event(
+            "request_admitted", req,
+            slot=slot, queue_wait_s=round(queue_wait, 4),
+            prompt_tokens=len(req.prompt),
+            step=int(getattr(self.decoder, "steps", 0)),
+        )
         try:
             with self.tracer.span(
                 "prefill", slot=slot, prompt_tokens=len(req.prompt)
@@ -564,11 +644,20 @@ class ContinuousScheduler:
             self._release_slot(slot)
             self._fail(req, e)
             return
+        ttft = max(0.0, time.time() - req.t0)
         if self.telemetry:
             now = time.perf_counter()
             self._m_prefill.observe(now - t_admit)
             # First token is sampled inside prefill, so TTFT lands here.
-            self._m_ttft.observe(max(0.0, time.time() - req.t0))
+            self._m_ttft.observe(ttft)
+            self._m_tenant_ttft.labels(tenant=req.tenant).observe(ttft)
+        self._event(
+            "request_prefill", req, slot=slot,
+            prefill_s=round(time.perf_counter() - t_admit, 4),
+            prompt_tokens=int(info.get("prompt_tokens", 0)),
+        )
+        self._event("request_first_token", req, slot=slot,
+                    ttft_s=round(ttft, 4))
         req.slot = slot
         req.prompt_tokens = int(info.get("prompt_tokens", 0))
         req.admitted_step = int(getattr(self.decoder, "steps", 0))
@@ -639,6 +728,10 @@ class ContinuousScheduler:
                 self._admit(nxt, active)
             else:
                 self._pending.append(nxt)
+        # Decode-tick accumulator: one SUMMARY event per tick_every steps
+        # (per-step events would be all the ring buffer ever holds).
+        tick_steps = tick_tokens = 0
+        tick_t0 = time.perf_counter()
         while active:
             self._admit_queued(key, active)
             if not active:
@@ -653,15 +746,29 @@ class ContinuousScheduler:
                     self._fail(r, e)
                     self._release(r, active)
                 return
+            n_produced = sum(1 for slot in active if produced[slot])
             if self.telemetry:
                 self._m_step.observe(step_dt)
                 self._m_decode_steps.inc()
-                n_produced = sum(
-                    1 for slot in active if produced[slot]
-                )
                 # Per-token decode latency: the step IS the inter-token
                 # gap for every lane that emitted this step.
                 self._m_token.observe(step_dt, count=max(0, n_produced))
+            tick_steps += 1
+            tick_tokens += max(0, n_produced)
+            if tick_steps >= self.tick_every:
+                dt_tick = time.perf_counter() - tick_t0
+                self._event(
+                    "decode_tick",
+                    step=int(getattr(self.decoder, "steps", 0)),
+                    steps=tick_steps, tokens=tick_tokens,
+                    active_lanes=len(active),
+                    queue_depth=self.queue_depth(),
+                    tokens_per_sec=round(
+                        tick_tokens / max(dt_tick, 1e-9), 1
+                    ),
+                )
+                tick_steps = tick_tokens = 0
+                tick_t0 = time.perf_counter()
             now = time.time()
             for slot, r in list(active.items()):
                 if r.cancelled:
@@ -747,11 +854,21 @@ class ChatServer:
         request_timeout_s: Optional[float] = None,
         max_queue_depth: int = 128,
         drain_grace_s: float = 30.0,
+        flight_dir: Optional[str] = None,
+        max_tenants: int = 64,
+        recorder: Optional[FlightRecorder] = None,
     ):
         self.engine = engine
         self.telemetry = bool(telemetry)
         self.registry = registry or get_registry()
         self.tracer = tracer or NULL_TRACER
+        # Wide-event trail (monitoring/events.py): request identity is
+        # minted at the HTTP layer, lifecycle events come from the
+        # scheduler, and drain dumps the ring into flight_dir for
+        # `lumina events` (crash forensics; docs/observability.md).
+        self.recorder = recorder if recorder is not None else get_recorder()
+        self.flight_dir = flight_dir
+        self.max_tenants = max(1, int(max_tenants))
         # Graceful degradation (docs/resilience.md): deadlines evict
         # overdue lanes, queue-depth overload sheds with 503+Retry-After,
         # and SIGTERM drains in-flight work before shutdown.
@@ -786,6 +903,8 @@ class ChatServer:
                 telemetry=telemetry,
                 latency_buckets=latency_buckets,
                 request_timeout_s=request_timeout_s,
+                recorder=self.recorder,
+                max_tenants=self.max_tenants,
             )
         else:
             self.batcher = MicroBatcher(
@@ -814,6 +933,27 @@ class ChatServer:
             "serving_overload_rejections_total",
             "Generation requests shed with 503 + Retry-After because the "
             "admission queue was at max_queue_depth",
+        )
+        # Per-tenant request accounting (the substrate ROADMAP item 2's
+        # fair-share admission prices QoS against). Bounded cardinality:
+        # max_tenants distinct labels, then `_overflow`.
+        tk = dict(labelnames=("tenant",), max_label_values=self.max_tenants)
+        self._m_tenant_requests = r.counter(
+            "tenant_requests_total",
+            "Generation requests accepted for processing, per tenant",
+            **tk,
+        )
+        self._m_tenant_tokens_in = r.counter(
+            "tenant_tokens_in_total",
+            "Prompt tokens submitted, per tenant", **tk,
+        )
+        self._m_tenant_tokens_out = r.counter(
+            "tenant_tokens_out_total",
+            "Generated tokens returned, per tenant", **tk,
+        )
+        self._m_tenant_shed = r.counter(
+            "tenant_requests_shed_total",
+            "Requests rejected 503 (drain or overload), per tenant", **tk,
         )
         r.gauge(
             "serve_ready",
@@ -871,6 +1011,10 @@ class ChatServer:
         serve_draining gauge; in-flight lanes keep decoding to completion."""
         if not self._draining:
             self._draining = True
+            if self.telemetry:
+                self.recorder.emit(
+                    "drain_started", queue_depth=self._queue_depth()
+                )
             logger.warning(
                 "drain started: new generations rejected, in-flight work "
                 "finishing (queue_depth=%d)", self._queue_depth(),
@@ -889,18 +1033,34 @@ class ChatServer:
         deadline = time.time() + (
             self.drain_grace_s if timeout_s is None else float(timeout_s)
         )
+        idle = False
         while time.time() < deadline:
             if self._idle():
                 logger.info("drain complete: scheduler idle")
-                return True
+                idle = True
+                break
             time.sleep(0.05)
-        idle = self._idle()
         if not idle:
-            logger.warning(
-                "drain grace expired with work still in flight; "
-                "shutting down anyway"
-            )
+            idle = self._idle()
+            if not idle:
+                logger.warning(
+                    "drain grace expired with work still in flight; "
+                    "shutting down anyway"
+                )
+        if self.telemetry:
+            self.recorder.emit("drain_finished", idle=idle)
+        # Crash forensics: the event trail survives the shutdown as a
+        # flightrec-*.jsonl dump next to the checkpoints (lumina events
+        # replays it; docs/observability.md "Flight recorder").
+        self.dump_flight_record("drain")
         return idle
+
+    def dump_flight_record(self, reason: str) -> Optional[str]:
+        """Dump the wide-event ring buffer into flight_dir (no-op without
+        one). Never raises — it rides shutdown paths."""
+        if not self.flight_dir:
+            return None
+        return self.recorder.dump_to_dir(self.flight_dir, reason)
 
     def _queue_depth(self) -> int:
         qd = getattr(self.batcher, "queue_depth", None)
@@ -1058,26 +1218,60 @@ class ChatServer:
                 return 401, {"error": "authentication failed"}
             return 200, {"token": token}
         if method == "POST" and path in ("/v1/generate", "/v1/chat"):
+            request_id = new_request_id()
             shed = self._shed()  # drain/overload: reject before auth work
             if shed is not None:
+                self._count_shed(request_id, token, path)
+                shed[1]["request_id"] = request_id
                 return shed
             with self.state_lock:
-                err = self._gate(body, token)
+                err, tenant = self._gate(body, token)
             if err is not None:
                 return err
-            return self._run_model(path, body)
+            return self._run_model(
+                path, body, request_id=request_id, tenant=tenant
+            )
         return 404, {"error": f"no route {method} {path}"}
 
+    def _tenant_of(self, token: Optional[str]) -> str:
+        """Tenant label outside the gate (shed accounting): hashed
+        session identity or the shared anon tenant. One HMAC, no
+        password work — cheap enough for the overload path."""
+        if not self.secure or not token:
+            return ANON_TENANT
+        with self.state_lock:
+            sess = self.security.validate_session(token)
+        return tenant_hash(sess["username"]) if sess else ANON_TENANT
+
+    def _count_shed(self, request_id: str, token: Optional[str],
+                    route: str) -> None:
+        # Same off switch as the scheduler's _event: telemetry off means
+        # no accounting work at all (including the session-HMAC tenant
+        # resolution), so the overhead A/B stays honest.
+        if not self.telemetry:
+            return
+        tenant = self._tenant_of(token)
+        self._m_tenant_shed.labels(tenant=tenant).inc()
+        self.recorder.emit(
+            "request_shed", request_id=request_id, tenant=tenant,
+            route=route,
+            reason="drain" if self._draining else "overload",
+        )
+
     def _gate(self, body: Dict[str, Any], token: Optional[str]):
-        """Secure-mode checks: session token, rate limit, input validation."""
+        """Secure-mode checks: session token, rate limit, input
+        validation. Returns (error_tuple | None, tenant_label) — the
+        tenant is the hashed authenticated identity, so accounting and
+        events never carry raw usernames."""
         if not self.secure:
-            return None
+            return None, ANON_TENANT
         session = self.security.validate_session(token or "")
         if session is None:
-            return 401, {"error": "missing or invalid token"}
+            return (401, {"error": "missing or invalid token"}), ANON_TENANT
         user = session.get("username", "anonymous")
+        tenant = tenant_hash(user)
         if not self.limiter.is_allowed(user, "chat"):
-            return 429, {"error": "rate limit exceeded"}
+            return (429, {"error": "rate limit exceeded"}), tenant
         text = body.get("prompt") or body.get("message") or ""
         if not text and body.get("messages"):
             text = " ".join(
@@ -1085,10 +1279,10 @@ class ChatServer:
             )
         verdict = self.validator.validate_user_input(str(text))
         if not verdict.valid:
-            return 400, {
+            return (400, {
                 "error": f"input rejected: {'; '.join(verdict.errors)}"
-            }
-        return None
+            }), tenant
+        return None, tenant
 
     # (name, clamp) — requests cannot push sampling params outside sane
     # bounds; max_new_tokens is capped so one request can't hold the decode
@@ -1142,14 +1336,20 @@ class ChatServer:
             reply_key = "text"
         return None, prompt_ids, overrides, reply_key
 
-    def _run_model(self, path: str, body: Dict[str, Any]) -> tuple:
+    def _run_model(self, path: str, body: Dict[str, Any],
+                   request_id: Optional[str] = None,
+                   tenant: str = ANON_TENANT) -> tuple:
         t0 = time.time()
+        request_id = request_id or new_request_id()
         err, prompt_ids, overrides, reply_key = self._parse_request(path, body)
         if err is not None:
             return err
+        self._account_request(request_id, tenant, path, len(prompt_ids),
+                              stream=False)
         if body.get("speculative"):
             out = self._run_speculative(
-                prompt_ids, overrides, reply_key, t0
+                prompt_ids, overrides, reply_key, t0,
+                request_id=request_id, tenant=tenant,
             )
             if out is not None:
                 return out
@@ -1160,17 +1360,47 @@ class ChatServer:
         # batched decode (MicroBatcher); sampling overrides go as generate
         # kwargs, so there is no config mutation to serialize.
         timeout_s = self._effective_timeout(body)
-        if self.continuous and timeout_s:
-            # Deadlines are a continuous-scheduler contract (step-level
-            # eviction); the legacy run-to-completion path can't evict.
-            overrides = {**overrides, "timeout_s": timeout_s}
+        if self.continuous:
+            # Identity riders (stripped before the compile key) + the
+            # deadline, a continuous-scheduler contract (step-level
+            # eviction); the legacy run-to-completion path gets neither
+            # (its engine kwargs reach generate_batch verbatim).
+            overrides = {
+                **overrides, "request_id": request_id, "tenant": tenant,
+            }
+            if timeout_s:
+                overrides["timeout_s"] = timeout_s
         try:
             tokens, stats = self.batcher.submit(prompt_ids, overrides)
         except RequestTimeout as e:
-            return 504, {"error": str(e)}
-        return self._reply_payload(tokens, stats, reply_key, t0)
+            return 504, {
+                "error": str(e), "request_id": request_id, "tenant": tenant,
+            }
+        return self._reply_payload(
+            tokens, stats, reply_key, t0,
+            request_id=request_id, tenant=tenant,
+        )
 
-    def _reply_payload(self, tokens, stats, reply_key, t0, **extra) -> tuple:
+    def _account_request(self, request_id, tenant, route, prompt_tokens,
+                         stream) -> None:
+        """Per-tenant admission accounting + the request_received event
+        (one choke point for the JSON and SSE paths). Rides the same
+        off switch as the metrics."""
+        if not self.telemetry:
+            return
+        self._m_tenant_requests.labels(tenant=tenant).inc()
+        self._m_tenant_tokens_in.labels(tenant=tenant).inc(
+            int(prompt_tokens)
+        )
+        self.recorder.emit(
+            "request_received", request_id=request_id, tenant=tenant,
+            route=route, stream=bool(stream),
+            prompt_tokens=int(prompt_tokens),
+        )
+
+    def _reply_payload(self, tokens, stats, reply_key, t0,
+                       request_id: Optional[str] = None,
+                       tenant: Optional[str] = None, **extra) -> tuple:
         """Shared response building + stats booking for the batched and
         speculative generation paths."""
         out = {reply_key: self.engine.tokenizer.decode(tokens)}
@@ -1181,6 +1411,8 @@ class ChatServer:
         if self.telemetry:
             self._m_request.observe(time.time() - t0)
             self._m_tokens_out.inc(n_tok)
+            if tenant:
+                self._m_tenant_tokens_out.labels(tenant=tenant).inc(n_tok)
         self.mark_ready()  # a served request is proof of readiness
         out.update(
             tokens=n_tok,
@@ -1188,6 +1420,11 @@ class ChatServer:
             stopped=stats.get("stopped"),
             **extra,
         )
+        if request_id is not None:
+            # Correlation contract: the id in this reply matches the
+            # request's server-side events and /metrics tenant series.
+            out["request_id"] = request_id
+            out["tenant"] = tenant or ANON_TENANT
         return 200, out
 
     def _speculative_eligible(self, overrides) -> bool:
@@ -1208,7 +1445,8 @@ class ChatServer:
         )
         return key[1] <= 0.0 and key[4] == 1.0
 
-    def _run_speculative(self, prompt_ids, overrides, reply_key, t0):
+    def _run_speculative(self, prompt_ids, overrides, reply_key, t0,
+                         request_id=None, tenant=None):
         """Greedy requests with {"speculative": true} run the engine's
         prompt-lookup speculative decode (exactly the greedy sequence,
         several tokens per device call on repetitive text). Single-stream
@@ -1232,6 +1470,7 @@ class ChatServer:
             self._stream_slots.release()
         return self._reply_payload(
             tokens, stats, reply_key, t0,
+            request_id=request_id, tenant=tenant,
             speculative={
                 "verify_calls": stats.get("verify_calls"),
                 "tokens_per_verify": stats.get("tokens_per_verify"),
@@ -1246,11 +1485,14 @@ class ChatServer:
         decode directly (one stream per request thread) rather than the
         MicroBatcher — each stream owns its decode cadence; batched SSE
         would couple every client's latency to the slowest stream."""
+        request_id = new_request_id()
         shed = self._shed()  # drain/overload applies to streams too
         if shed is not None:
+            self._count_shed(request_id, token, path)
+            shed[1]["request_id"] = request_id
             return shed, None
         with self.state_lock:
-            err = self._gate(body, token)
+            err, tenant = self._gate(body, token)
         if err is not None:
             return err, None
         if not self.continuous and not hasattr(
@@ -1260,6 +1502,8 @@ class ChatServer:
         err, prompt_ids, overrides, reply_key = self._parse_request(path, body)
         if err is not None:
             return err, None
+        self._account_request(request_id, tenant, path, len(prompt_ids),
+                              stream=True)
         timeout_s = self._effective_timeout(body)
         if (
             body.get("speculative")
@@ -1282,30 +1526,42 @@ class ChatServer:
                 overrides = {**overrides, "timeout_s": timeout_s}
             return None, _SlotStream(
                 self._stream_events(
-                    prompt_ids, overrides, reply_key, speculative=True
+                    prompt_ids, overrides, reply_key, speculative=True,
+                    request_id=request_id, tenant=tenant,
                 ),
                 self._stream_slots.release,
             )
-        if self.continuous and timeout_s:
-            overrides = {**overrides, "timeout_s": timeout_s}
         if self.continuous:
+            # Identity riders for the scheduler's lifecycle events
+            # (stripped before the compile key) + the deadline.
+            overrides = {
+                **overrides, "request_id": request_id, "tenant": tenant,
+            }
+            if timeout_s:
+                overrides["timeout_s"] = timeout_s
             # Streams ride the shared continuous decode loop like any
             # other request — concurrency is bounded by the KV pool's
             # slots (excess queues), so the legacy per-stream slot cap
             # does not apply. Closing the generator cancels the lane.
-            return None, self._stream_events(prompt_ids, overrides, reply_key)
+            return None, self._stream_events(
+                prompt_ids, overrides, reply_key,
+                request_id=request_id, tenant=tenant,
+            )
         if not self._stream_slots.acquire(blocking=False):
             return (
                 503,
                 {"error": "too many concurrent streams; retry shortly"},
             ), None
         return None, _SlotStream(
-            self._stream_events(prompt_ids, overrides, reply_key),
+            self._stream_events(prompt_ids, overrides, reply_key,
+                                request_id=request_id, tenant=tenant),
             self._stream_slots.release,
         )
 
     def _stream_events(self, prompt_ids, overrides, reply_key,
-                       speculative: bool = False):
+                       speculative: bool = False,
+                       request_id: Optional[str] = None,
+                       tenant: str = ANON_TENANT):
         """Yield SSE event dicts: {'token','delta'} per token, then a
         final {'done': True, <reply_key>: full_text, ...stats}.
 
@@ -1340,6 +1596,8 @@ class ChatServer:
             if self.telemetry:
                 self._m_stream.observe(time.time() - t0)
                 self._m_tokens_out.inc(n)
+                if tenant:
+                    self._m_tenant_tokens_out.labels(tenant=tenant).inc(n)
             span.set(tokens=n)
             self.mark_ready()
 
@@ -1375,6 +1633,13 @@ class ChatServer:
                         "tokens": int(item.get("tokens_generated", 0)),
                         "latency_s": round(time.time() - t0, 3),
                         "stopped": item.get("stopped"),
+                        # Correlation contract (docs/serving.md): the
+                        # done frame carries the same id/tenant as the
+                        # server-side events and /metrics series.
+                        "request_id": (
+                            request_id or item.get("request_id")
+                        ),
+                        "tenant": item.get("tenant", tenant),
                     }
                     if item.get("verify_calls") is not None:
                         # Speculative stream: the done frame carries the
@@ -1399,6 +1664,19 @@ class ChatServer:
                 else:
                     delta = ""
                 yield {"token": int(item), "delta": delta}
+        except Exception as e:
+            # Mid-stream failures (deadline eviction, decode error)
+            # become a CORRELATABLE error frame — request_id + tenant —
+            # instead of the handler's anonymous fallback frame. The
+            # [DONE] terminator still follows from _reply_sse.
+            # GeneratorExit (client gone) is BaseException: untouched.
+            logger.warning("stream %s failed: %s", request_id, e)
+            yield {
+                "error": str(e),
+                "request_id": request_id,
+                "tenant": tenant,
+            }
+            return
         finally:
             count(len(tokens))
             stream_span.__exit__(None, None, None)
@@ -1625,6 +1903,8 @@ def serve(
     request_timeout_s: Optional[float] = None,
     max_queue_depth: int = 128,
     drain_grace_s: float = 30.0,
+    flight_dir: Optional[str] = None,
+    max_tenants: int = 64,
 ):
     """Build an engine from a checkpoint and serve it (CLI `serve`)."""
     from luminaai_tpu.inference.chat import ChatInterface
@@ -1647,6 +1927,10 @@ def serve(
         request_timeout_s=request_timeout_s,
         max_queue_depth=max_queue_depth,
         drain_grace_s=drain_grace_s,
+        # Drain dumps the wide-event ring next to the checkpoint (or the
+        # working dir) so a SIGTERM'd server leaves a queryable trail.
+        flight_dir=flight_dir or checkpoint or ".",
+        max_tenants=max_tenants,
         latency_buckets=(
             tuple(latency_buckets)
             if latency_buckets

@@ -31,6 +31,7 @@ from jax.sharding import NamedSharding
 
 from luminaai_tpu.config import Config
 from luminaai_tpu.models.transformer import LuminaTransformer
+from luminaai_tpu.monitoring.events import FlightRecorder, get_recorder
 from luminaai_tpu.monitoring.logger import TrainingHealthMonitor
 from luminaai_tpu.monitoring.telemetry import MetricsRegistry, get_registry
 from luminaai_tpu.monitoring.tracing import NULL_TRACER, SpanTracer
@@ -111,6 +112,7 @@ class Trainer:
         steps_per_epoch: Optional[int] = None,
         registry: Optional[MetricsRegistry] = None,
         tracer: Optional[SpanTracer] = None,
+        recorder: Optional[FlightRecorder] = None,
     ):
         self.config = config
         self.train_data = train_data
@@ -163,6 +165,10 @@ class Trainer:
         # recompile counters and health gauges ride one exposition path.
         self.registry = registry or get_registry()
         self.tracer = tracer or NULL_TRACER
+        # Wide-event flight recorder (monitoring/events.py): step/router/
+        # recompile/preemption events land in the process ring; the
+        # emergency-save paths dump it next to the checkpoints.
+        self.recorder = recorder if recorder is not None else get_recorder()
         self.checkpoints = CheckpointManager(
             config, ckpt_dir, registry=self.registry
         )
@@ -200,6 +206,7 @@ class Trainer:
             grad_norm_threshold=config.grad_norm_threshold,
             health_check_interval=config.health_check_interval,
             registry=self.registry,
+            recorder=self.recorder,
             wandb_config={
                 "enable": config.enable_wandb,
                 "project": config.wandb_project,
@@ -383,6 +390,10 @@ class Trainer:
         intervention loop shows up here before it shows up as lost
         throughput)."""
         self._m_recompiles.labels(reason=reason or "config_change").inc()
+        self.recorder.emit(
+            "recompile", step=self.global_step,
+            reason=reason or "config_change",
+        )
 
     # -- adaptive hooks (called by the orchestrator) ----------------------
     def adjust_learning_rate(self, new_lr: float, reason: str = "") -> None:
@@ -954,6 +965,7 @@ class Trainer:
                     self._m_tps.set(scalars["tokens_per_sec"])
                     window_t0, window_tokens, window_steps = now, 0, 0
                     self.monitor.log_step(self.global_step, scalars)
+                    self._export_router_health(metrics, scalars)
                     last_metrics = scalars
                     if self.step_callback is not None:
                         cb_metrics = dict(scalars)
@@ -975,7 +987,11 @@ class Trainer:
                     and self.global_step % cfg.eval_every_n_batches == 0
                 ):
                     eval_metrics = self.evaluate()
-                    self.monitor.log_step(self.global_step, eval_metrics)
+                    # Eval windows are their own event type — a replayed
+                    # dump's train_step cadence must not conflate them.
+                    self.monitor.log_step(
+                        self.global_step, eval_metrics, event="eval_step"
+                    )
                     last_metrics.update(eval_metrics)
                     if self._check_early_stopping(eval_metrics.get("eval_loss")):
                         stop = True
@@ -1025,10 +1041,16 @@ class Trainer:
                     )
                     self._preempted = True
                     self._m_preemptions.inc()
+                    self.recorder.emit(
+                        "preemption", step=self.global_step, reason=reason,
+                    )
                     self.checkpoints.emergency_save(
                         self.state, self.global_step, reason=reason,
                         data_state=self._data_state(),
                     )
+                    # The trail must survive the exit: dump the last N
+                    # step/router events next to the emergency save.
+                    self._dump_flight_record(reason)
                     stop = True
                     break
             else:
@@ -1067,6 +1089,79 @@ class Trainer:
         }
         logger.info("training done: %s", summary)
         return summary
+
+    # -- router health (docs/observability.md "Router health") ------------
+    def _export_router_health(self, metrics, scalars) -> None:
+        """Per-expert load + router-entropy telemetry at log cadence.
+
+        The vector leaves the device HERE, at the same whole-window sync
+        the scalar float() conversions just performed — no new host sync
+        enters the step path (LX002 stays clean). Gauges:
+        moe_expert_load{expert} (share of KEPT routed tokens, sums to
+        ~1.0), moe_router_entropy, moe_max_expert_share, moe_drop_rate;
+        plus one router_health event per log window."""
+        util = metrics.get("expert_utilization")
+        if util is None:
+            return
+        try:
+            util = np.asarray(util, dtype=np.float64)
+        except Exception:
+            return
+        E = int(util.shape[-1])
+        total = float(util.sum())
+        # expert_utilization is f*E (1.0 == balanced); normalize to the
+        # kept-token share per expert so the loads sum to ~1.0.
+        load = (util / total) if total > 0 else np.full(E, 1.0 / max(E, 1))
+        r = self.registry
+        if E <= 256:  # bounded gauge cardinality, whatever the config
+            g = r.gauge(
+                "moe_expert_load",
+                "Share of kept routed tokens per expert (sums to ~1.0; "
+                "1/E == balanced)",
+                labelnames=("expert",),
+                max_label_values=256,
+            )
+            for i in range(E):
+                g.labels(expert=str(i)).set(float(load[i]))
+        entropy = scalars.get("moe_router_entropy")
+        if entropy is not None:
+            r.gauge(
+                "moe_router_entropy",
+                "Mean per-token routing entropy (ln(num_experts) == "
+                "uniform, 0 == collapsed)",
+            ).set(entropy)
+        max_share = scalars.get("moe_max_expert_share")
+        if max_share is not None:
+            r.gauge(
+                "moe_max_expert_share",
+                "Hottest expert's share of kept routed tokens",
+            ).set(max_share)
+        drop = scalars.get("moe_drop_rate")
+        if drop is not None:
+            r.gauge(
+                "moe_drop_rate",
+                "Fraction of tokens losing >=1 routing slot to capacity "
+                "(capacity dispatch paths)",
+            ).set(drop)
+        self.recorder.emit(
+            "router_health", step=self.global_step,
+            expert_load=[round(float(x), 4) for x in load],
+            entropy=(
+                round(float(entropy), 4) if entropy is not None else None
+            ),
+            max_share=(
+                round(float(max_share), 4) if max_share is not None else None
+            ),
+            drop_rate=round(float(drop), 4) if drop is not None else None,
+        )
+
+    # -- crash forensics (docs/observability.md "Flight recorder") --------
+    def _dump_flight_record(self, reason: str) -> Optional[str]:
+        """Dump the wide-event ring next to the checkpoints so the last
+        N step/request events survive the exit (`lumina events` replays
+        the flightrec-*.jsonl). Never raises — it rides the emergency
+        paths."""
+        return self.recorder.dump_to_dir(str(self.checkpoints.dir), reason)
 
     # -- profiling (SURVEY §5 tracing) -------------------------------------
     def _maybe_profile(self) -> None:
@@ -1337,10 +1432,15 @@ class Trainer:
             "no checkpoint at or before step %d; aborting with emergency save",
             safe,
         )
+        self.recorder.emit(
+            "train_abort", step=self.global_step,
+            reason="non-finite loss, no rollback point",
+        )
         self.checkpoints.emergency_save(
             self.state, self.global_step, "non-finite loss, no rollback point",
             data_state=self._data_state(),
         )
+        self._dump_flight_record("non_finite")
         return True
 
     def _check_early_stopping(self, eval_loss: Optional[float]) -> bool:

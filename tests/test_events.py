@@ -1,0 +1,570 @@
+"""Wide-event flight recorder, per-tenant accounting, router health
+(docs/observability.md "Flight recorder" / "Per-tenant accounting" /
+"Router health").
+
+Covers the event spine end to end: ring-buffer semantics and dump
+round-trips, the concurrent scrape-vs-emit thread-safety contract,
+serving request lifecycle events with request_id/tenant correlation,
+bounded tenant label cardinality, the `lumina events` CLI, and the
+crash-forensics dump an injected preemption leaves next to the
+emergency checkpoint.
+"""
+
+import glob
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from luminaai_tpu.config import Config
+from luminaai_tpu.monitoring.events import (
+    EVENT_SCHEMA_VERSION,
+    FlightRecorder,
+    filter_events,
+    format_event,
+    get_recorder,
+    latest_dump,
+    read_events,
+    set_recorder,
+)
+from luminaai_tpu.monitoring.telemetry import (
+    OVERFLOW_LABEL,
+    MetricsRegistry,
+)
+from luminaai_tpu.serving.server import ChatServer, ContinuousScheduler
+
+
+# ---------------------------------------------------------------------------
+# recorder semantics
+# ---------------------------------------------------------------------------
+def test_recorder_envelope_and_ring_bound():
+    rec = FlightRecorder(capacity=3)
+    for i in range(5):
+        rec.emit("tick", i=i)
+    snap = rec.snapshot()
+    assert [e["i"] for e in snap] == [2, 3, 4]  # last `capacity` only
+    assert all(e["v"] == EVENT_SCHEMA_VERSION for e in snap)
+    assert [e["seq"] for e in snap] == [3, 4, 5]  # monotone across eviction
+    assert rec.dropped == 2
+    assert rec.counts_by_type() == {"tick": 5}  # lifetime, not ring-bound
+
+
+def test_recorder_snapshot_filters():
+    rec = FlightRecorder()
+    rec.emit("a", x=1)
+    rec.emit("b", x=2)
+    rec.emit("a", x=3)
+    assert [e["x"] for e in rec.snapshot(type="a")] == [1, 3]
+    assert [e["x"] for e in rec.snapshot(last=2)] == [2, 3]
+
+
+def test_dump_roundtrip_and_latest(tmp_path):
+    rec = FlightRecorder()
+    rec.emit("step", loss=1.5, obj=object())  # non-JSON field: stringified
+    path = rec.dump_to_dir(str(tmp_path), "SIGTERM preempt!")
+    assert path is not None and "sigterm_preempt" in path
+    events = read_events(path)
+    assert len(events) == 1 and events[0]["loss"] == 1.5
+    assert isinstance(events[0]["obj"], str)
+    assert latest_dump(str(tmp_path)) == path
+    # A dump into an unwritable location must not raise (crash path).
+    assert rec.dump_to_dir("/proc/nonexistent/x", "r") is None
+
+
+def test_read_events_skips_truncated_tail(tmp_path):
+    p = tmp_path / "flightrec-x.jsonl"
+    p.write_text('{"v":1,"type":"a","ts":1,"seq":1}\n{"v":1,"ty')
+    assert [e["type"] for e in read_events(str(p))] == ["a"]
+
+
+def test_filter_and_format():
+    evs = [
+        {"v": 1, "ts": 1.0, "seq": i, "type": t, "msg": f"m{i}"}
+        for i, t in enumerate(["a", "b", "a", "a"])
+    ]
+    assert len(filter_events(evs, type="a")) == 3
+    assert len(filter_events(evs, grep="m[23]")) == 2
+    assert [e["seq"] for e in filter_events(evs, type="a", tail=2)] == [2, 3]
+    line = format_event(evs[0])
+    assert "a" in line and "msg=m0" in line
+
+
+def test_process_default_recorder_swap():
+    rec = FlightRecorder()
+    prev = set_recorder(rec)
+    try:
+        assert get_recorder() is rec
+    finally:
+        set_recorder(prev)
+
+
+# ---------------------------------------------------------------------------
+# thread-safety contract: scrape racing emit (satellite)
+# ---------------------------------------------------------------------------
+def test_concurrent_scrape_vs_emit():
+    """/metrics rendering + recorder snapshots racing event emission and
+    metric updates from handler-like threads: no exceptions, no lost
+    events, parseable exposition throughout."""
+    rec = FlightRecorder(capacity=512)
+    reg = MetricsRegistry()
+    hist = reg.histogram("race_seconds", "t", labelnames=("tenant",))
+    ctr = reg.counter("race_total", "t", labelnames=("tenant",))
+    errors = []
+    N_THREADS, N_EVENTS = 6, 200
+
+    def producer(tid):
+        try:
+            for i in range(N_EVENTS):
+                rec.emit("req", tid=tid, i=i)
+                ctr.labels(tenant=f"t{tid}").inc()
+                hist.labels(tenant=f"t{tid}").observe(0.001 * i)
+        except Exception as e:  # pragma: no cover - the failure signal
+            errors.append(e)
+
+    stop = threading.Event()
+
+    def scraper():
+        try:
+            while not stop.is_set():
+                text = reg.render_prometheus()
+                assert "race_total" in text
+                snap = rec.snapshot()
+                # Emission order is preserved under concurrency.
+                seqs = [e["seq"] for e in snap]
+                assert seqs == sorted(seqs)
+                rec.counts_by_type()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=producer, args=(t,))
+        for t in range(N_THREADS)
+    ] + [threading.Thread(target=scraper) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads[:N_THREADS]:
+        t.join(timeout=30)
+    stop.set()
+    for t in threads[N_THREADS:]:
+        t.join(timeout=30)
+    assert not errors, errors
+    assert rec.counts_by_type()["req"] == N_THREADS * N_EVENTS
+    total = sum(
+        ctr.labels(tenant=f"t{t}").value for t in range(N_THREADS)
+    )
+    assert total == N_THREADS * N_EVENTS
+
+
+# ---------------------------------------------------------------------------
+# registry label hardening (satellite): bounded tenant cardinality
+# ---------------------------------------------------------------------------
+def test_label_overflow_bucket_bounds_cardinality():
+    reg = MetricsRegistry()
+    c = reg.counter("t_total", "t", labelnames=("tenant",),
+                    max_label_values=3)
+    for i in range(10):
+        c.labels(tenant=f"user{i}").inc()
+    text = reg.render_prometheus()
+    # 3 real series + one _overflow absorbing the other 7.
+    assert text.count("t_total{") == 4, text
+    assert c.labels(tenant="user9").value == 7.0  # resolves to _overflow
+    assert f'tenant="{OVERFLOW_LABEL}"' in text
+    # Established series keep accumulating after the budget is spent.
+    c.labels(tenant="user0").inc()
+    assert c.labels(tenant="user0").value == 2.0
+
+
+def test_label_value_length_clamped():
+    reg = MetricsRegistry()
+    g = reg.gauge("l_gauge", "t", labelnames=("k",))
+    g.labels(k="x" * 500).set(1)
+    text = reg.render_prometheus()
+    assert "x" * 65 not in text
+    assert "x" * 64 in text
+    # Same long value resolves to the same (clamped) child.
+    assert g.labels(k="x" * 400).value == 1.0
+
+
+# ---------------------------------------------------------------------------
+# serving: request lifecycle events + per-tenant accounting
+# ---------------------------------------------------------------------------
+class _Tok:
+    class backend:
+        @staticmethod
+        def encode(text):
+            return [ord(c) % 250 for c in text]
+
+    def decode(self, tokens):
+        return ",".join(str(t) for t in tokens)
+
+
+class _Stepper:
+    """Deterministic StepwiseDecoder double over a real PagedKVPool
+    (mirrors tests/test_resilience.py's _Stepper)."""
+
+    def __init__(self, num_slots=2, slot_tokens=64):
+        from luminaai_tpu.inference.kv_pool import PagedKVPool
+
+        self.num_slots = num_slots
+        self.slot_tokens = slot_tokens
+        self.pool = PagedKVPool(None, num_slots, 1, slot_tokens)
+        self.steps = 0
+        self._active = [False] * num_slots
+        self._next = [0] * num_slots
+
+    def has_free_slot(self):
+        return self.pool.has_free()
+
+    def acquire_slot(self):
+        return self.pool.alloc()
+
+    def release_slot(self, slot):
+        self._active[slot] = False
+        self.pool.free(slot)
+
+    def lane_full(self, slot):
+        return False
+
+    def prefill_into_slot(self, slot, prompt, max_new_tokens=1,
+                          sample_key=None, seed=None):
+        first = int(prompt[0])
+        self._active[slot] = max_new_tokens > 1
+        self._next[slot] = first + 1
+        self.pool.lengths[slot] = len(prompt)
+        return {"token": first, "prompt_tokens": len(prompt),
+                "is_stop": False}
+
+    def decode_step(self, sample_key=None):
+        toks = np.zeros((self.num_slots,), np.int64)
+        eos = np.zeros((self.num_slots,), bool)
+        produced = np.asarray(self._active, bool).copy()
+        for s in range(self.num_slots):
+            if self._active[s]:
+                toks[s] = self._next[s]
+                self._next[s] += 1
+        self.steps += 1
+        return toks, produced, eos
+
+
+class _Engine:
+    def __init__(self):
+        self.config = Config(
+            vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+            num_kv_heads=2, seq_length=64, use_flash_attention=False,
+        )
+        self.tokenizer = _Tok()
+        self.stepper = _Stepper(2)
+
+    def make_stepwise(self, **kw):
+        return self.stepper
+
+    def encode_chat(self, messages):
+        return self.tokenizer.backend.encode(messages[-1]["content"])
+
+
+def test_scheduler_lifecycle_events_carry_identity():
+    rec = FlightRecorder()
+    reg = MetricsRegistry()
+    eng = _Engine()
+    sched = ContinuousScheduler(
+        eng, decoder=eng.stepper, registry=reg, recorder=rec,
+    )
+    toks, stats = sched.submit(
+        [40], {"max_new_tokens": 4, "request_id": "rid1", "tenant": "tA"}
+    )
+    assert toks == [40, 41, 42, 43]
+    assert stats["request_id"] == "rid1" and stats["tenant"] == "tA"
+    by_type = {}
+    for e in rec.snapshot():
+        by_type.setdefault(e["type"], []).append(e)
+    for t in ("request_admitted", "request_prefill",
+              "request_first_token", "request_completed"):
+        assert t in by_type, (t, sorted(by_type))
+        assert by_type[t][0]["request_id"] == "rid1"
+        assert by_type[t][0]["tenant"] == "tA"
+    done = by_type["request_completed"][0]
+    assert done["tokens"] == 4 and done["stopped"] == "length"
+    assert by_type["request_admitted"][0]["queue_wait_s"] >= 0.0
+    # Per-tenant TTFT landed under the tenant label.
+    assert reg.get("tenant_ttft_seconds").labels(tenant="tA").count == 1
+
+
+def test_scheduler_identity_not_a_compile_key():
+    """Two tenants' otherwise-identical requests must resolve the same
+    sampling key (one shared decode executable)."""
+    eng = _Engine()
+    sched = ContinuousScheduler(eng, decoder=eng.stepper,
+                                registry=MetricsRegistry(),
+                                recorder=FlightRecorder())
+    r1 = sched._make_request([1], {"max_new_tokens": 4, "tenant": "a",
+                                   "request_id": "x"}, stream=False)
+    r2 = sched._make_request([1], {"max_new_tokens": 4, "tenant": "b",
+                                   "request_id": "y"}, stream=False)
+    assert r1.sample_key == r2.sample_key
+    assert r1.tenant == "a" and r2.tenant == "b"
+
+
+def test_timeout_eviction_event_and_tenant_counter():
+    from luminaai_tpu.testing.faults import slow_decode
+
+    rec = FlightRecorder()
+    reg = MetricsRegistry()
+    eng = _Engine()
+    sched = ContinuousScheduler(
+        eng, decoder=eng.stepper, registry=reg, recorder=rec,
+    )
+    from luminaai_tpu.serving.server import RequestTimeout
+
+    with slow_decode(eng.stepper, 0.05):
+        with pytest.raises(RequestTimeout):
+            sched.submit([40], {"max_new_tokens": 500, "timeout_s": 0.2,
+                                "tenant": "slowpoke"})
+    ev = rec.snapshot(type="request_evicted")
+    assert ev and ev[-1]["reason"] == "timeout"
+    assert ev[-1]["tenant"] == "slowpoke"
+    assert reg.get("tenant_requests_timed_out_total").labels(
+        tenant="slowpoke"
+    ).value == 1
+
+
+def test_decode_tick_summary_events():
+    rec = FlightRecorder()
+    eng = _Engine()
+    sched = ContinuousScheduler(
+        eng, decoder=eng.stepper, registry=MetricsRegistry(),
+        recorder=rec, tick_every=4,
+    )
+    sched.submit([10], {"max_new_tokens": 20})
+    ticks = rec.snapshot(type="decode_tick")
+    assert ticks, rec.counts_by_type()
+    assert ticks[0]["steps"] == 4
+    assert ticks[0]["tokens"] >= 1 and "active_lanes" in ticks[0]
+
+
+def test_http_reply_and_sse_frames_carry_request_id(tmp_path):
+    import urllib.request
+    from http.server import ThreadingHTTPServer
+
+    rec = FlightRecorder()
+    reg = MetricsRegistry()
+    srv = ChatServer(_Engine(), registry=reg, recorder=rec,
+                     flight_dir=str(tmp_path))
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), srv.make_handler())
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        def post(body):
+            req = urllib.request.Request(
+                url + "/v1/generate", data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.read().decode()
+
+        body = json.loads(post({"prompt": "hey", "max_new_tokens": 3}))
+        rid = body["request_id"]
+        assert rid and body["tenant"] == "anon"
+        # The reply's id correlates with the server-side event trail.
+        assert any(
+            e.get("request_id") == rid
+            for e in rec.snapshot(type="request_completed")
+        )
+
+        raw = post({"prompt": "hi", "stream": True, "max_new_tokens": 3})
+        frames = [ln[6:] for ln in raw.split("\n")
+                  if ln.startswith("data: ")]
+        assert frames[-1] == "[DONE]"
+        done = json.loads(frames[-2])
+        assert done.get("done") and done["request_id"]
+        assert done["tenant"] == "anon"
+
+        # Per-tenant accounting on the same scrape.
+        text = reg.render_prometheus()
+        assert 'tenant_requests_total{tenant="anon"} 2' in text
+        assert 'tenant_tokens_out_total{tenant="anon"}' in text
+
+        # Drain dumps the trail for forensics.
+        assert srv.drain(5.0) is True
+        dumps = glob.glob(str(tmp_path / "flightrec-*.jsonl"))
+        assert dumps
+        dumped = read_events(dumps[0])
+        assert any(e["type"] == "request_completed" for e in dumped)
+        assert any(e["type"] == "drain_started" for e in dumped)
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_telemetry_off_suppresses_server_events():
+    """ChatServer(telemetry=False) must emit NOTHING onto the spine —
+    the same off switch as the scheduler's _event, so the overhead A/B
+    (metrics+events on vs off) measures both producers."""
+    rec = FlightRecorder()
+    srv = ChatServer(_Engine(), registry=MetricsRegistry(), recorder=rec,
+                     telemetry=False)
+    code, body = srv.handle(
+        "POST", "/v1/generate", {"prompt": "x", "max_new_tokens": 2}, None
+    )
+    assert code == 200 and body["request_id"]  # correlation ids stay
+    srv.drain(0.1)
+    assert len(rec) == 0, rec.snapshot()
+
+
+def test_shed_counts_per_tenant():
+    rec = FlightRecorder()
+    reg = MetricsRegistry()
+    srv = ChatServer(_Engine(), registry=reg, recorder=rec,
+                     max_queue_depth=1)
+    srv.batcher.queue_depth = lambda: 99  # saturated
+    code, body = srv.handle("POST", "/v1/generate", {"prompt": "x"}, None)
+    assert code == 503 and body["request_id"]
+    shed = rec.snapshot(type="request_shed")
+    assert shed and shed[0]["reason"] == "overload"
+    assert reg.get("tenant_requests_shed_total").labels(
+        tenant="anon"
+    ).value == 1
+
+
+# ---------------------------------------------------------------------------
+# training: preemption dump + router health (fault-injection harness)
+# ---------------------------------------------------------------------------
+@pytest.fixture()
+def tiny_moe_trainer(tmp_path):
+    from luminaai_tpu.data.dataset import PrefetchLoader
+    from luminaai_tpu.training.trainer import Trainer
+
+    cfg = Config(
+        vocab_size=64, hidden_size=32, num_layers=1, num_heads=2,
+        num_kv_heads=1, seq_length=16, batch_size=8, use_moe=True,
+        num_experts=2, moe_top_k=2, use_flash_attention=False,
+        gradient_checkpointing=False, precision="fp32", max_steps=5,
+        eval_every_n_batches=10**6, save_every_n_batches=10**6,
+        health_check_interval=10,  # log_every = 1: every step logs
+        output_dir=str(tmp_path), learning_rate=1e-3,
+    )
+
+    def gen(epoch=0):
+        rng = np.random.RandomState(epoch)
+        for _ in range(20):
+            yield {"input_ids": rng.randint(
+                1, 60, size=(8, 16)).astype(np.int32)}
+
+    rec = FlightRecorder()
+    reg = MetricsRegistry()
+    t = Trainer(
+        cfg, train_data=PrefetchLoader(gen, prefetch=2),
+        checkpoint_dir=str(tmp_path / "ckpt"), registry=reg, recorder=rec,
+    )
+    yield t, rec, reg, str(tmp_path / "ckpt")
+    t.close()
+
+
+@pytest.mark.faults
+def test_preemption_dumps_flight_record(tiny_moe_trainer):
+    """Injected SIGTERM-equivalent preemption mid-train leaves a
+    flightrec-*.jsonl next to the emergency checkpoint holding the last
+    N step/router events, and `lumina events` replays it."""
+    from luminaai_tpu.cli import main
+    from luminaai_tpu.testing.faults import preempt_at_step
+
+    t, rec, reg, ckpt = tiny_moe_trainer
+    with preempt_at_step(t, 3):
+        summary = t.train()
+    assert summary["preempted"]
+    dumps = glob.glob(ckpt + "/flightrec-*.jsonl")
+    assert dumps, "no flight-record dump next to the emergency save"
+    events = read_events(dumps[0])
+    types = {e["type"] for e in events}
+    assert {"train_step", "router_health", "preemption"} <= types, types
+    steps = [e["step"] for e in events if e["type"] == "train_step"]
+    assert steps == sorted(steps) and steps[-1] == 3
+    # The CLI replays the dump (CI runs the same smoke).
+    assert main(["events", "--tail", "5", dumps[0]]) == 0
+    assert main(["events", "--type", "preemption", "--json", ckpt]) == 0
+
+
+@pytest.mark.faults
+def test_router_health_gauges_and_events(tiny_moe_trainer):
+    """Per-expert load gauges sum to ~1.0 in live telemetry, entropy and
+    max-share gauges exist, and router_health events ride the spine —
+    all exported at log cadence (no step-path host sync: LX002 is
+    enforced by `lumina analyze` in CI)."""
+    t, rec, reg, _ = tiny_moe_trainer
+    t.train()
+    snap = reg.snapshot()
+    load = snap.get("moe_expert_load")
+    assert load and len(load) == 2
+    assert abs(sum(load.values()) - 1.0) < 0.01, load
+    assert 0.0 < snap["moe_router_entropy"] <= np.log(2) + 1e-6
+    assert 0.0 < snap["moe_max_expert_share"] <= 1.0
+    rh = rec.snapshot(type="router_health")
+    assert rh and len(rh[-1]["expert_load"]) == 2
+    assert abs(sum(rh[-1]["expert_load"]) - 1.0) < 0.01
+    # Satellite: the legacy logger path emits onto the SAME spine.
+    assert rec.snapshot(type="train_step")
+
+
+def test_cli_events_live_buffer_and_missing_path(tmp_path, capsys):
+    from luminaai_tpu.cli import main
+
+    rec = FlightRecorder()
+    prev = set_recorder(rec)
+    try:
+        rec.emit("hello", x=1)
+        assert main(["events", "--json"]) == 0
+        out = capsys.readouterr().out.strip().splitlines()
+        assert json.loads(out[-1])["type"] == "hello"
+    finally:
+        set_recorder(prev)
+    assert main(["events", str(tmp_path / "nope.jsonl")]) == 2
+    assert main(["events", str(tmp_path)]) == 2  # dir without dumps
+    assert main(["events", "--grep", "["]) == 2  # bad regex: clean exit
+
+
+def test_eval_windows_keep_their_own_event_type():
+    """Eval metrics logged through the monitor land as eval_step, never
+    polluting the train_step cadence a replayed dump reports."""
+    from luminaai_tpu.monitoring.logger import TrainingHealthMonitor
+
+    rec = FlightRecorder()
+    mon = TrainingHealthMonitor(recorder=rec)
+    mon.log_step(3, {"loss": 2.0})
+    mon.log_step(3, {"eval_loss": 1.9}, event="eval_step")
+    assert [e["type"] for e in rec.snapshot()] == ["train_step", "eval_step"]
+    assert rec.snapshot(type="eval_step")[0]["eval_loss"] == 1.9
+
+
+def test_monitor_alerts_ride_the_spine():
+    """MetricsCollector alerts land as `alert` events (one trail, not
+    two half-trails)."""
+    from luminaai_tpu.monitoring.logger import MetricsCollector
+
+    rec = FlightRecorder()
+    coll = MetricsCollector(recorder=rec)
+    coll.add_metric("loss", float("nan"), step=7)
+    alerts = rec.snapshot(type="alert")
+    assert alerts and alerts[0]["severity"] == "critical"
+    assert alerts[0]["step"] == 7
+
+
+def test_recorder_dump_names_unique_within_second(tmp_path):
+    """Repeated same-second dumps (e.g. SIGTERM hammering the forced
+    signal handler) must each keep their own forensic record — never
+    os.replace an earlier one."""
+    rec = FlightRecorder()
+    rec.emit("a")
+    paths = [rec.dump_to_dir(str(tmp_path), "r") for _ in range(4)]
+    assert all(paths) and len(set(paths)) == 4, paths
+    assert len(glob.glob(str(tmp_path / "flightrec-*.jsonl"))) == 4
+
+
+def test_event_emit_overhead_is_small():
+    """The spine must stay off the hot path's budget: 10k emits well
+    under a second (one lock + deque append each)."""
+    rec = FlightRecorder(capacity=1024)
+    t0 = time.perf_counter()
+    for i in range(10_000):
+        rec.emit("x", i=i)
+    assert time.perf_counter() - t0 < 1.0
