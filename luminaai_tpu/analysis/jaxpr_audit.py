@@ -13,12 +13,14 @@ Three auditors:
 - `enumerate_recompile_surface` traces the train step and the decode
   steps across the config variants the codebase actually forks on
   (scan_layers on/off, gmm vs capacity einsum dispatch, prefill
-  prompt buckets, scalar-offset vs batched `cache_index` decode) and
-  hashes each variant's jaxpr. The distinct-signature count is the
-  number of executables XLA must compile to serve those scenarios —
-  the number ROADMAP item 5's unified-forward refactor exists to
-  drive down. `train_recompiles_total` counts the symptom at runtime;
-  this enumerates the cause ahead of time.
+  prompt-length scenarios — ONE chunked-prefill executable since the
+  LaneMeta unification collapsed the bucket ladder — scalar-offset vs
+  batched `cache_index` decode) and hashes each variant's jaxpr. The
+  distinct-signature count is the number of executables XLA must
+  compile to serve those scenarios — the number ROADMAP item 5's
+  unified-forward refactor exists to drive down (prefill went first:
+  4 -> 3 decode signatures). `train_recompiles_total` counts the
+  symptom at runtime; this enumerates the cause ahead of time.
 
 - `audit_sharding_coverage` walks the abstract boxed param tree and
   flags leaves that carry no logical PartitionSpec annotation
@@ -234,7 +236,7 @@ class _AuditTokenizer:
         return " ".join(str(t) for t in tokens)
 
 
-_DECODE_PREFILL_BUCKETS = (32, 64)
+_DECODE_PREFILL_SCENARIOS = (32, 64)  # prompt lengths to serve
 
 
 def _decode_variants(cfg) -> List[Dict[str, Any]]:
@@ -260,20 +262,46 @@ def _decode_variants(cfg) -> List[Dict[str, Any]]:
     engine = GenerationEngine(model, pabs, _AuditTokenizer(), cfg)
     out = []
 
-    # Prompt-bucketed prefill: ONE executable per bucket — the surface
-    # scales with the bucket ladder, which is why it is enumerated, not
-    # assumed.
-    for bucket in _DECODE_PREFILL_BUCKETS:
-        out.append(
-            jaxpr_signature(
-                engine._make_prefill_fn(bucket),
-                pabs,
-                jax.ShapeDtypeStruct((1, bucket), jnp.int32),
-                jax.ShapeDtypeStruct((), jnp.int32),
-                program="decode",
-                variant=f"prefill/bucket={bucket}",
-            )
+    # Prefill scenarios (serve a 32-token prompt, serve a 64-token
+    # prompt): under the bucket ladder each prompt-length bucket was its
+    # own executable; chunked prefill (config.prefill_chunk_size) feeds
+    # every prompt through ONE fixed-chunk step, so the scenarios now
+    # share a signature — the first decode-surface reduction the
+    # LaneMeta unification bought (ROADMAP item 5). Each scenario is
+    # still enumerated so the variant list keeps describing workloads,
+    # not implementation details.
+    chunk = engine._prefill_chunk_len()
+    if chunk:
+        caches = jax.eval_shape(
+            lambda: model.init_cache(1, engine.max_context)
         )
+        for scenario in _DECODE_PREFILL_SCENARIOS:
+            out.append(
+                jaxpr_signature(
+                    engine._make_chunk_prefill_fn(chunk),
+                    pabs,
+                    caches,
+                    jax.ShapeDtypeStruct((1, chunk), jnp.int32),
+                    jax.ShapeDtypeStruct((), jnp.int32),
+                    jax.ShapeDtypeStruct((), jnp.int32),
+                    program="decode",
+                    variant=(
+                        f"prefill/prompt={scenario}/chunk={chunk}"
+                    ),
+                )
+            )
+    else:  # pragma: no cover - legacy bucket-ladder configs
+        for bucket in _DECODE_PREFILL_SCENARIOS:
+            out.append(
+                jaxpr_signature(
+                    engine._make_prefill_fn(bucket),
+                    pabs,
+                    jax.ShapeDtypeStruct((1, bucket), jnp.int32),
+                    jax.ShapeDtypeStruct((), jnp.int32),
+                    program="decode",
+                    variant=f"prefill/bucket={bucket}",
+                )
+            )
 
     # Scalar-offset decode: the single-sequence while-loop body
     # (cache_index is a scalar start offset).

@@ -683,6 +683,7 @@ def cmd_serve(args) -> int:
         max_queue_depth=getattr(args, "max_queue_depth", 128),
         drain_grace_s=getattr(args, "drain_grace_s", 30.0),
         flight_dir=getattr(args, "flight_dir", None),
+        prefill_chunk_tokens=getattr(args, "prefill_chunk_tokens", None),
     )
     return 0
 
@@ -1379,6 +1380,12 @@ def build_parser() -> argparse.ArgumentParser:
                          "(concurrent decode lanes)")
     sv.add_argument("--page-size", dest="page_size", type=int, default=128,
                     help="KV pool page granularity in tokens")
+    sv.add_argument("--prefill-chunk", dest="prefill_chunk_tokens",
+                    type=int, default=None,
+                    help="chunked-prefill chunk size in tokens: long "
+                         "admissions prefill one chunk per decode tick "
+                         "instead of stalling the batch (default: the "
+                         "config's prefill_chunk_size; 0 disables)")
     sv.add_argument("--admission-window-ms", dest="admission_window_ms",
                     type=float, default=0.0,
                     help="wait this long for same-key peers before a "

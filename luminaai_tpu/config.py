@@ -63,6 +63,31 @@ class Config:
     # attention dots). Quantization happens at insert; prefill/decode
     # math is otherwise unchanged.
     kv_cache_dtype: str = "bf16"
+    # Serving attention backend for the length-aware (LaneMeta) decode/
+    # prefill paths — scalar-offset decode, batched per-lane decode over
+    # the slot-paged pool, and chunked prefill all dispatch through it
+    # (ops/ragged_paged_attention.py):
+    #   'dense'      legacy full-extent per-lane masking (parity oracle);
+    #   'ragged_xla' pure-XLA length-masked reference — the serving
+    #                default: bit-identical to 'dense' on resident rows,
+    #                and the decode step slices K/V to the resident page
+    #                extent so decode cost scales with tokens resident,
+    #                not pool capacity;
+    #   'ragged'     Pallas page-table-native decode kernel when eligible
+    #                (ragged_eligible), ragged_xla otherwise. Compiled on
+    #                TPU, interpret mode on CPU (slow — use for parity
+    #                tests, not CPU serving).
+    # Rolling (windowed O(window)) caches always take the dense path —
+    # their slot arithmetic is mod-C, which LaneMeta does not describe.
+    attention_backend: str = "ragged_xla"
+    # Chunked prefill: prompts prefill in fixed chunks of this many
+    # tokens — ONE executable for every prompt length (instead of a
+    # power-of-two bucket ladder), and the serving scheduler interleaves
+    # chunks with decode steps so a long admission cannot stall the
+    # decode batch for more than ~one chunk's step time. 0 disables
+    # (legacy bucketed prefill). Engines with a rolling windowed cache
+    # ignore it (chunk writes are only defined on non-wrapping layouts).
+    prefill_chunk_size: int = 64
     # Sliding-window (local) attention: each position attends to at most
     # the `attention_window` most recent positions (itself included).
     # None = full causal. The flash kernels skip whole blocks outside the
@@ -380,6 +405,12 @@ class Config:
         )
         assert self.kv_cache_dtype in ("bf16", "int8"), (
             f"invalid kv_cache_dtype {self.kv_cache_dtype}"
+        )
+        assert self.attention_backend in ("dense", "ragged_xla", "ragged"), (
+            f"invalid attention_backend {self.attention_backend}"
+        )
+        assert self.prefill_chunk_size >= 0, (
+            "prefill_chunk_size must be >= 0 (0 disables chunked prefill)"
         )
         if self.attention_window is not None:
             assert self.attention_window > 0, (

@@ -200,11 +200,121 @@ class GenerationEngine:
         self._decode_fn = {}  # keyed by generation kwargs (static args)
         self._prefill_fn = functools.lru_cache(maxsize=16)(self._make_prefill)
 
+    def _lane_hint(self):
+        """Backend-only LaneMeta threaded into every jitted model call:
+        the ENGINE's config decides the attention backend even when the
+        model was built from a different config (the same override
+        contract kv_cache_dtype has). The attention layer derives
+        lengths/window itself."""
+        from luminaai_tpu.ops.ragged_paged_attention import LaneMeta
+
+        return LaneMeta(
+            lengths=None,
+            backend=getattr(self.config, "attention_backend", "dense"),
+        )
+
     # -- prefill -----------------------------------------------------------
+    def _prefill_chunk_len(self) -> int:
+        """Static chunk length for chunked prefill; 0 when disabled or
+        when the engine's cache can roll (attention_window) — chunk
+        writes are only defined on non-wrapping layouts, so windowed
+        single-stream engines keep the bucket ladder."""
+        chunk = int(getattr(self.config, "prefill_chunk_size", 0) or 0)
+        if chunk <= 0:
+            return 0
+        if getattr(self.config, "attention_window", None) is not None:
+            return 0
+        return min(chunk, self.max_context)
+
+    def _make_chunk_prefill_fn(self, chunk: int):
+        """One fixed-shape prefill step: feed `chunk` prompt rows at
+        positions start..start+chunk-1 (rows past `length` marked -1)
+        into the carried cache, return the cache and the logits at the
+        prompt's last row (clamped; consumed only on the final chunk).
+        ONE executable serves every prompt length — the O(log S) bucket
+        ladder this replaces is the decode-side recompile surface
+        ROADMAP item 5 drives down."""
+
+        hint = self._lane_hint()
+
+        def chunk_fn(params, caches, ids, start, length):
+            pos = start + jnp.arange(chunk)
+            positions = jnp.where(pos < length, pos, -1)[None, :]
+            logits, caches, _ = self.model.apply(
+                {"params": params},
+                ids,
+                positions=positions,
+                kv_caches=caches,
+                cache_index=start,
+                deterministic=True,
+                lane_meta=hint,
+            )
+            last_idx = jnp.clip(length - 1 - start, 0, chunk - 1)
+            last = jnp.take_along_axis(
+                logits, last_idx[None, None, None], axis=1
+            )[:, 0, :]
+            return last, caches
+
+        return chunk_fn
+
+    def _get_chunk_prefill(self, chunk: int):
+        key = ("chunk_prefill", chunk)
+        if key not in self._decode_fn:
+            # The cache carry is donated: each chunk consumes the
+            # previous chunk's buffers (per-request state — a failed
+            # call costs only that request, unlike the shared pool).
+            self._decode_fn[key] = jax.jit(
+                self._make_chunk_prefill_fn(chunk), donate_argnums=(1,)
+            )
+        return self._decode_fn[key]
+
+    def _prefill_chunked(self, prompt: List[int], chunk: int):
+        """Chunked prefill driver: ceil(L/chunk) re-entries into the one
+        chunk executable. Cache rows and the last live row's logits
+        match the bucketed path's — K/V rows depend only on their own
+        token/position, and each chunk's attention admits exactly the
+        rows the full-bucket mask admits."""
+        L = len(prompt)
+        # An empty prompt still runs ONE chunk (all padding rows), so the
+        # caller always gets logits — matching the bucket path, which fed
+        # an all-pad bucket rather than skipping the forward.
+        n = max(1, -(-L // chunk))
+        ids = np.zeros((1, n * chunk), dtype=np.int32)
+        ids[0, :L] = prompt
+        caches = self.model.init_cache(
+            1, self.max_context,
+            kv_cache_dtype=getattr(self.config, "kv_cache_dtype", None),
+        )
+        fn = self._get_chunk_prefill(chunk)
+        length = jnp.asarray(L, jnp.int32)
+        logits = None
+        for c in range(n):
+            start = c * chunk
+            if start + chunk > self.max_context:
+                # The padded chunk grid may overhang a cache whose extent
+                # is not chunk-aligned; XLA CLAMPS an out-of-range
+                # dynamic_update_slice start, which would land this
+                # chunk's rows on top of earlier residents. Re-anchor the
+                # window to end at the cache edge instead: the re-fed
+                # overlap rows rewrite bit-identical K/V (a row depends
+                # only on its own token and position), so the cache is
+                # unchanged where it was already live.
+                start = self.max_context - chunk
+            logits, caches = fn(
+                self.params,
+                caches,
+                jnp.asarray(ids[:, start:start + chunk]),
+                jnp.asarray(start, jnp.int32),
+                length,
+            )
+        return logits, caches
+
     def _make_prefill(self, prompt_bucket: int):
         return jax.jit(self._make_prefill_fn(prompt_bucket))
 
     def _make_prefill_fn(self, prompt_bucket: int):
+        hint = self._lane_hint()
+
         def prefill(params, ids, length):
             # The ENGINE's config decides cache storage, so serving-time
             # overrides work regardless of which config built the model.
@@ -227,6 +337,7 @@ class GenerationEngine:
                 kv_caches=caches,
                 cache_index=0,
                 deterministic=True,
+                lane_meta=hint,
             )
             last = jnp.take_along_axis(
                 logits, (length - 1)[None, None, None], axis=1
@@ -245,6 +356,7 @@ class GenerationEngine:
         max_new, temperature, top_k, top_p, rep_penalty = gen_key
         max_new = max_new - 1  # the prefill already sampled token #1
         stop_ids = jnp.asarray(sorted(self._stop_set), dtype=jnp.int32)
+        hint = self._lane_hint()
 
         def cond(state):
             i, done = state[0], state[5]
@@ -261,6 +373,7 @@ class GenerationEngine:
                 kv_caches=caches,
                 cache_index=start + i,
                 deterministic=True,
+                lane_meta=hint,
             )
             nxt = sample_token(
                 step_rng, logits[0, -1], counts,
@@ -380,6 +493,7 @@ class GenerationEngine:
         little more than an S=1 step."""
         key = ("verify", k)
         if key not in self._decode_fn:
+            hint = self._lane_hint()
 
             def verify(params, ids, caches, start):
                 positions = (start + jnp.arange(k))[None, :]
@@ -391,6 +505,7 @@ class GenerationEngine:
                     cache_index=start,
                     deterministic=True,
                     multi_row_update=True,
+                    lane_meta=hint,
                 )
                 return (
                     jnp.argmax(logits[0], axis=-1).astype(jnp.int32),
@@ -592,13 +707,16 @@ class GenerationEngine:
         max_new = gen_key[0]
         prompt = self._trim_prompt(prompt_tokens, max_new)
         length = len(prompt)
-        bucket = min(_bucket_len(length), self.max_context)
-        ids = np.zeros((1, bucket), dtype=np.int32)
-        ids[0, :length] = prompt
-
-        first_logits, caches = self._prefill_fn(bucket)(
-            self.params, jnp.asarray(ids), jnp.asarray(length, jnp.int32)
-        )
+        chunk = self._prefill_chunk_len()
+        if chunk:
+            first_logits, caches = self._prefill_chunked(prompt, chunk)
+        else:
+            bucket = min(_bucket_len(length), self.max_context)
+            ids = np.zeros((1, bucket), dtype=np.int32)
+            ids[0, :length] = prompt
+            first_logits, caches = self._prefill_fn(bucket)(
+                self.params, jnp.asarray(ids), jnp.asarray(length, jnp.int32)
+            )
         counts = jnp.zeros((first_logits.shape[-1],), jnp.int32)
         rng = jax.random.key(
             seed if seed is not None else (time.time_ns() & 0xFFFFFFFF)
@@ -882,6 +1000,7 @@ class GenerationEngine:
         num_slots: int = 8,
         page_size: int = 128,
         max_slot_tokens: Optional[int] = None,
+        prefill_chunk_tokens: Optional[int] = None,
     ) -> "StepwiseDecoder":
         """Build a StepwiseDecoder: the scheduler-owned decode API
         (prefill_into_slot + decode_step) continuous batching runs on.
@@ -893,6 +1012,7 @@ class GenerationEngine:
             num_slots=num_slots,
             page_size=page_size,
             max_slot_tokens=max_slot_tokens,
+            prefill_chunk_tokens=prefill_chunk_tokens,
         )
 
 
@@ -933,6 +1053,7 @@ class StepwiseDecoder:
         num_slots: int = 8,
         page_size: int = 128,
         max_slot_tokens: Optional[int] = None,
+        prefill_chunk_tokens: Optional[int] = None,
     ):
         from luminaai_tpu.inference.kv_pool import PagedKVPool, to_paged
 
@@ -974,6 +1095,26 @@ class StepwiseDecoder:
         self._rngs = jax.random.split(jax.random.PRNGKey(0), num_slots)
         self.steps = 0
         self._fns: Dict[Any, Any] = {}
+        # Serving attention backend (config.attention_backend): 'dense'
+        # keeps the legacy full-extent per-lane mask; the ragged backends
+        # thread a LaneMeta (pool page table + resident page extent)
+        # through the decode step so attention reads O(tokens resident).
+        self.backend = getattr(
+            engine.config, "attention_backend", "dense"
+        )
+        # Device copy of the pool's page table, refreshed at admission
+        # (identity today; a prefix cache would retarget entries there).
+        self._table = jnp.asarray(self.pool.page_table_array())
+        # Chunked prefill: fixed chunk length (None -> the engine
+        # config's prefill_chunk_size), clamped to the slot budget;
+        # 0 disables, callers fall back to prefill_into_slot.
+        if prefill_chunk_tokens is None:
+            prefill_chunk_tokens = int(
+                getattr(engine.config, "prefill_chunk_size", 0) or 0
+            )
+        self.prefill_chunk = max(
+            0, min(int(prefill_chunk_tokens), self.token_capacity)
+        )
 
     # -- slot lifecycle ----------------------------------------------------
     def has_free_slot(self) -> bool:
@@ -1015,6 +1156,7 @@ class StepwiseDecoder:
             # by its occupant before the per-lane mask first admits it.
             ps = self.pool.page_size
             capacity = min(-(-bucket // ps) * ps, self.slot_tokens)
+            hint = self.engine._lane_hint()
 
             def prefill(params, ids, length):
                 caches = engine.model.init_cache(
@@ -1037,6 +1179,7 @@ class StepwiseDecoder:
                     # (the pool never rolls).
                     cache_index=jnp.zeros((1,), jnp.int32),
                     deterministic=True,
+                    lane_meta=hint,
                 )
                 last = jnp.take_along_axis(
                     logits, (length - 1)[None, None, None], axis=1
@@ -1071,19 +1214,62 @@ class StepwiseDecoder:
             self._fns["insert"] = jax.jit(insert)
         return self._fns["insert"]
 
-    def _get_step(self, sample_key):
-        key = ("step", sample_key)
+    def _active_extent(self) -> int:
+        """Resident-extent bound in ROWS for the ragged decode step: a
+        power-of-two page count covering every active lane's rows
+        (>= 1 page, <= the slot's pages). The step executable is
+        specialized per extent — O(log pages) executables, the same
+        ladder discipline as prompt buckets — and within one extent the
+        kernel/length mask still skips per-lane."""
+        ps = self.pool.page_size
+        need = 1
+        if self._active.any():
+            need = int(self._pos[self._active].max()) + 1
+        pages_needed = -(-need // ps)
+        p = 1
+        while p < pages_needed:
+            p *= 2
+        return min(p, self.pool.pages) * ps
+
+    def _get_step(self, sample_key, extent: Optional[int] = None):
+        key = ("step", sample_key, self.backend, extent)
         if key not in self._fns:
             temperature, top_k, top_p, rep_penalty = sample_key
             stop_ids = jnp.asarray(
                 sorted(self.engine._stop_set), dtype=jnp.int32
             )
             S = self.num_slots
+            backend = self.backend
+            window = getattr(self.engine.config, "attention_window", None)
+            page_size = self.pool.page_size
 
-            def step(params, caches, tokens, pos, active, counts, rngs):
+            def step(params, caches, tokens, pos, active, counts, rngs,
+                     table):
                 flat = self._flat(caches)
                 split2 = jax.vmap(lambda r: jax.random.split(r, 2))(rngs)
                 new_rngs, step_rngs = split2[:, 0], split2[:, 1]
+                from luminaai_tpu.ops.ragged_paged_attention import (
+                    LaneMeta,
+                )
+
+                if backend == "dense":
+                    meta = LaneMeta(lengths=None, backend="dense")
+                else:
+                    # lengths INCLUDE the row this step writes (pos);
+                    # 0 marks lanes with nothing attendable (free or
+                    # mid-chunked-prefill slots) whose output is garbage
+                    # the host discards via `active`.
+                    meta = LaneMeta(
+                        lengths=jnp.where(active, pos + 1, 0).astype(
+                            jnp.int32
+                        ),
+                        page_table=table,
+                        window=window,
+                        kind="decode",
+                        page_size=page_size,
+                        extent=extent,
+                        backend=backend,
+                    )
                 logits, flat, _ = self.model.apply(
                     {"params": params},
                     tokens[:, None],
@@ -1091,6 +1277,7 @@ class StepwiseDecoder:
                     kv_caches=flat,
                     cache_index=pos,  # [S]: per-lane offsets
                     deterministic=True,
+                    lane_meta=meta,
                 )
                 nxt = jax.vmap(
                     lambda r, l, c: sample_token(
@@ -1147,6 +1334,18 @@ class StepwiseDecoder:
         logits, fresh = self._get_prefill(bucket)(
             self.params, jnp.asarray(ids), jnp.asarray(L, jnp.int32)
         )
+        self.pool.caches = self._get_insert()(
+            self.pool.caches, fresh, jnp.asarray(slot, jnp.int32)
+        )
+        self._table = jnp.asarray(self.pool.page_table_array())
+        return self._finish_prefill(slot, logits, L, max_new, sample_key,
+                                    seed)
+
+    def _finish_prefill(self, slot, logits, L, max_new, sample_key, seed):
+        """Shared prompt-KV-written → lane-activated tail: sample token
+        #1, set the host lane state, return prefill_into_slot's info
+        contract. Used by the whole-prompt path above and by the final
+        chunk of a chunked prefill."""
         rng = jax.random.PRNGKey(
             seed if seed is not None else (time.time_ns() & 0xFFFFFFFF)
         )
@@ -1161,9 +1360,6 @@ class StepwiseDecoder:
             )
         )
         is_stop = first in self.engine._stop_set
-        self.pool.caches = self._get_insert()(
-            self.pool.caches, fresh, jnp.asarray(slot, jnp.int32)
-        )
         self.pool.lengths[slot] = L
         self._tokens[slot] = first
         self._pos[slot] = L
@@ -1178,14 +1374,156 @@ class StepwiseDecoder:
             "is_stop": is_stop,
         }
 
+    # -- chunked prefill (scheduler-interleaved admission) -----------------
+    def _get_chunk_prefill(self):
+        """One fixed-shape prefill step writing `prefill_chunk` rows of
+        one lane DIRECTLY into the pool slot (no fresh-cache + insert):
+        slice the lane off the slot axis, run the per-lane multi-row
+        path at absolute positions, land the updated lane back. ONE
+        executable for every prompt length; the scheduler interleaves
+        these calls with decode steps so a long admission stalls the
+        decode batch for at most ~one chunk's step time."""
+        key = "chunk_prefill"
+        if key not in self._fns:
+            engine = self.engine
+            chunk = self.prefill_chunk
+            hint = engine._lane_hint()
+
+            def chunk_fn(params, pool_caches, ids, slot, start, length):
+                def lane_of(p):
+                    return jax.lax.dynamic_slice_in_dim(
+                        p, slot, 1, axis=p.ndim - 5
+                    )
+
+                lane = jax.tree.map(lane_of, pool_caches)
+                flat = self._flat(lane)
+                pos = start + jnp.arange(chunk)
+                positions = jnp.where(pos < length, pos, -1)[None, :]
+                logits, flat, _ = engine.model.apply(
+                    {"params": params},
+                    ids,
+                    positions=positions,
+                    kv_caches=flat,
+                    # [1]-shaped start offset selects the per-lane
+                    # multi-row path: rows land at absolute positions,
+                    # -1-marked padding drops into the dummy row.
+                    cache_index=jnp.reshape(start, (1,)),
+                    deterministic=True,
+                    lane_meta=hint,
+                )
+                last_idx = jnp.clip(length - 1 - start, 0, chunk - 1)
+                last = jnp.take_along_axis(
+                    logits, last_idx[None, None, None], axis=1
+                )[:, 0, :]
+                paged_lane = self._paged(flat)
+
+                def put(p, fresh):
+                    starts = [0] * p.ndim
+                    starts[p.ndim - 5] = slot
+                    return jax.lax.dynamic_update_slice(
+                        p, fresh, tuple(starts)
+                    )
+
+                return last, jax.tree.map(put, pool_caches, paged_lane)
+
+            # Same no-donation rationale as the decode step: the pool
+            # must survive a failed chunk call.
+            self._fns[key] = jax.jit(chunk_fn)  # lumina: disable=LX006 -- pool must survive failed chunk calls; see decode-step comment
+        return self._fns[key]
+
+    def start_prefill(
+        self,
+        slot: int,
+        prompt_tokens: Sequence[int],
+        max_new_tokens: int = 1,
+        sample_key: Optional[Tuple] = None,
+        seed: Optional[int] = None,
+    ) -> Optional[Dict[str, Any]]:
+        """Begin a CHUNKED prefill into `slot`. Returns a host-side
+        state dict for advance_prefill, or None when chunking is
+        disabled (callers fall back to prefill_into_slot). The lane
+        stays inactive until the final chunk activates it."""
+        if not self.prefill_chunk:
+            return None
+        sample_key = sample_key or GREEDY_SAMPLE_KEY
+        max_new = max(1, int(max_new_tokens))
+        if not list(prompt_tokens):
+            raise ValueError("start_prefill needs a non-empty prompt")
+        prompt = self.engine._trim_prompt(
+            prompt_tokens, max_new, capacity=self.token_capacity
+        )
+        L = len(prompt)
+        chunk = self.prefill_chunk
+        if L <= chunk:
+            # A one-chunk prompt can't stall anyone longer than a chunk
+            # anyway, and the bucketed prefill_into_slot path moves only
+            # a page-aligned prompt prefix where a chunk call round-trips
+            # the whole lane — cheaper AND the stall bound still holds.
+            return None
+        n = -(-L // chunk)
+        ids = np.zeros((1, n * chunk), np.int32)
+        ids[0, :L] = prompt
+        # Interleaved decode steps still write one (garbage) row at
+        # _pos for every lane, active or not; park the mid-prefill
+        # lane's write row at the slot's LAST row — admission bounds
+        # prompts to token_capacity - 1, so no chunk writes it, and a
+        # lane that eventually decodes there overwrites it before its
+        # mask first admits it.
+        self._pos[slot] = self.slot_tokens - 1
+        self._active[slot] = False
+        self.pool.lengths[slot] = 0
+        self._table = jnp.asarray(self.pool.page_table_array())
+        return {
+            "slot": slot, "ids": ids, "length": L, "chunk": chunk,
+            "next": 0, "n_chunks": n, "sample_key": sample_key,
+            "seed": seed, "max_new": max_new,
+        }
+
+    def advance_prefill(
+        self, st: Dict[str, Any]
+    ) -> Optional[Dict[str, Any]]:
+        """Run ONE prefill chunk (one jit call). Returns None while
+        chunks remain; the final chunk samples token #1, activates the
+        lane, and returns prefill_into_slot's info dict."""
+        c = st["next"]
+        chunk = st["chunk"]
+        slot = st["slot"]
+        fn = self._get_chunk_prefill()
+        logits, caches = fn(
+            self.params,
+            self.pool.caches,
+            jnp.asarray(st["ids"][:, c * chunk:(c + 1) * chunk]),
+            jnp.asarray(slot, jnp.int32),
+            jnp.asarray(c * chunk, jnp.int32),
+            jnp.asarray(st["length"], jnp.int32),
+        )
+        self.pool.caches = caches
+        st["next"] = c + 1
+        if st["next"] < st["n_chunks"]:
+            # Residency telemetry tracks rows as they land; the lane
+            # itself stays inactive until the final chunk.
+            self.pool.lengths[slot] = min(
+                (c + 1) * chunk, st["length"]
+            )
+            return None
+        return self._finish_prefill(
+            slot, logits, st["length"], st["max_new"],
+            st["sample_key"], st["seed"],
+        )
+
     def step_fn_and_args(
         self, sample_key: Optional[Tuple] = None
     ) -> Tuple[Any, Tuple]:
         """The jitted decode-step function and the argument tuple
         decode_step would call it with right now. Exposed so
         monitoring/attribution.py can AOT-lower the decode executable for
-        compiled-cost accounting without executing a step."""
-        fn = self._get_step(sample_key or GREEDY_SAMPLE_KEY)
+        compiled-cost accounting without executing a step (bench
+        extras.ragged_attention compares the dense and ragged backends'
+        compiled bytes through exactly this handle)."""
+        extent = (
+            self._active_extent() if self.backend != "dense" else None
+        )
+        fn = self._get_step(sample_key or GREEDY_SAMPLE_KEY, extent)
         args = (
             self.params,
             self.pool.caches,
@@ -1194,6 +1532,7 @@ class StepwiseDecoder:
             jnp.asarray(self._active),
             self._counts,
             self._rngs,
+            self._table,
         )
         return fn, args
 

@@ -86,6 +86,17 @@ class PagedKVPool:
         self.pages = int(pages)
         self.page_size = int(page_size)
         self.lengths = np.zeros((num_slots,), np.int64)
+        # Per-slot page table: logical page j of slot s lives at physical
+        # page `page_tables[s, j]` of the slot's own page axis. Identity
+        # today — the indirection is the seam page sharing / compaction
+        # (prefix caching, ROADMAP item 2) will retarget; the ragged
+        # attention kernel already chases it. Rows are RESET to identity
+        # at alloc and never mutated while a slot is live, so a live
+        # lane's pages can never silently alias another's (contract-
+        # tested).
+        self.page_tables = np.tile(
+            np.arange(pages, dtype=np.int32), (num_slots, 1)
+        )
         # LIFO free-list: the most recently freed slot is re-issued first,
         # so its cache rows are the warmest in HBM when overwritten.
         self._free: List[int] = list(range(num_slots - 1, -1, -1))
@@ -121,6 +132,10 @@ class PagedKVPool:
             if slot in self._allocated:  # pragma: no cover - invariant guard
                 raise RuntimeError(f"slot {slot} double-allocated")
             self._allocated.add(slot)
+            # Fresh occupants start from the identity layout; a future
+            # prefix cache retargets entries AFTER alloc, never across a
+            # free/realloc boundary.
+            self.page_tables[slot] = np.arange(self.pages, dtype=np.int32)
             if self.slot_uses[slot] > 0:
                 self.reuses += 1
             self.slot_uses[slot] += 1
@@ -140,6 +155,23 @@ class PagedKVPool:
     def allocated_slots(self) -> List[int]:
         with self._lock:
             return sorted(self._allocated)
+
+    # -- device-transferable metadata views ------------------------------
+    def page_table_array(self) -> np.ndarray:
+        """[num_slots, pages] int32 SNAPSHOT of the page tables — a copy,
+        so the scheduler can hand it to a jit call while HTTP threads
+        alloc/free, and mutating the view can never corrupt pool
+        accounting. Identity rows for every slot today (contract-tested
+        with the no-alias invariant)."""
+        with self._lock:
+            return self.page_tables.copy()
+
+    def lengths_array(self) -> np.ndarray:
+        """[num_slots] int32 snapshot of rows resident per slot (0 for
+        free slots) — the `lengths` operand of the ragged attention
+        kernel, in the dtype it wants on device."""
+        with self._lock:
+            return self.lengths.astype(np.int32)
 
     # -- occupancy accounting (telemetry) --------------------------------
     def pages_in_use(self) -> int:

@@ -198,6 +198,7 @@ class GQAttention(nn.Module):
         kv_cache: Optional[Tuple[jax.Array, jax.Array]] = None,
         cache_index: Optional[jax.Array] = None,
         deterministic: bool = True,
+        lane_meta: Optional[Any] = None,
     ):
         cfg = self.config
         B, S, H = x.shape
@@ -304,6 +305,8 @@ class GQAttention(nn.Module):
 
         new_cache = None
         rolling_prefill = False
+        per_lane = False
+        rolling = False
         if kv_cache is not None:
             ck, cv = kv_cache
             C_cache = (ck[0] if isinstance(ck, tuple) else ck).shape[1]
@@ -567,14 +570,78 @@ class GQAttention(nn.Module):
                 window=cfg.attention_window,
             )
         else:
-            out = self._xla_attention(
-                q, k, v,
-                kv_cache is not None and not rolling_prefill,
-                cache_index,
+            decoding_att = kv_cache is not None and not rolling_prefill
+            # The ENGINE's backend choice (threaded as LaneMeta.backend)
+            # beats the model's construction-time config — serving-time
+            # overrides must not require a model rebuild.
+            backend = (
+                getattr(lane_meta, "backend", None)
+                or getattr(cfg, "attention_backend", "dense")
             )
+            if decoding_att and backend != "dense" and not rolling:
+                # Length-aware (LaneMeta) dispatch: scalar-offset decode,
+                # batched per-lane decode, and (chunked) prefill all
+                # describe themselves the same way and share ONE masking
+                # implementation (ops/ragged_paged_attention.py) — the
+                # per-variant forks below survive only as the 'dense'
+                # oracle and the rolling-cache layouts, whose mod-C slot
+                # arithmetic LaneMeta deliberately does not model.
+                out = self._ragged_attention(
+                    q, k, v, lane_meta, cache_index, positions, backend
+                )
+            else:
+                out = self._xla_attention(
+                    q, k, v, decoding_att, cache_index
+                )
 
         y = _out_proj(out)
         return y, new_cache
+
+    def _ragged_attention(self, q, k, v, meta, cache_index, positions,
+                          backend):
+        """Dispatch decode/prefill attention through the ragged
+        paged-attention interface. Callers on the slot-paged KV pool pass
+        a LaneMeta carrying the pool's page table and a static resident-
+        extent bound; everyone else (scalar-offset decode, bucketed
+        prefill, speculative verify) gets one derived here — identity
+        pages, lengths recovered from positions/cache_index, full extent
+        — which reproduces the dense per-lane mask bit-for-bit on
+        resident rows."""
+        from luminaai_tpu.ops.ragged_paged_attention import (
+            LaneMeta,
+            implied_page_size,
+            paged_attention,
+        )
+
+        B, Sq = q.shape[0], q.shape[1]
+        if meta is not None and meta.lengths is None:
+            meta = None  # backend hint only; derive everything below
+        if meta is None:
+            if positions is not None:
+                lengths = jnp.max(positions, axis=1).astype(jnp.int32) + 1
+            elif getattr(cache_index, "ndim", 0) == 1:
+                lengths = cache_index.astype(jnp.int32) + Sq
+            else:
+                lengths = jnp.full((B,), cache_index + Sq, jnp.int32)
+            meta = LaneMeta(
+                lengths=lengths,
+                window=self.config.attention_window,
+                kind="decode" if Sq == 1 else "prefill",
+                page_size=implied_page_size(k.shape[1]),
+            )
+        if meta.extent is not None and meta.extent < k.shape[1]:
+            # Post-write resident-extent slice: decode reads O(tokens
+            # resident), not O(pool capacity). XLA prices a slice at its
+            # output bytes, so the compiled decode step's bytes-accessed
+            # drop with residency (bench extras.ragged_attention pins
+            # this against the dense baseline).
+            k = jax.lax.slice_in_dim(k, 0, meta.extent, axis=1)
+            v = jax.lax.slice_in_dim(v, 0, meta.extent, axis=1)
+        return paged_attention(
+            q, k, v, meta,
+            backend=backend,
+            positions=positions if Sq > 1 else None,
+        )
 
     def _xla_attention(self, q, k, v, decoding: bool, cache_index):
         """Einsum attention fallback (ref core/model.py:783 _standard_attention).

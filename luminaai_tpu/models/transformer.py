@@ -71,6 +71,7 @@ class TransformerBlock(nn.Module):
         positions: Optional[jax.Array] = None,
         kv_cache: Optional[Tuple[jax.Array, jax.Array]] = None,
         cache_index: Optional[jax.Array] = None,
+        lane_meta: Optional[Any] = None,
     ):
         cfg = self.config
         deterministic = self.deterministic
@@ -84,6 +85,7 @@ class TransformerBlock(nn.Module):
             positions=positions,
             kv_cache=kv_cache,
             cache_index=cache_index,
+            lane_meta=lane_meta,
         )
         h = checkpoint_name(h, "attn_out")
         x = x + h
@@ -190,7 +192,7 @@ class _ScanUnit(nn.Module):
     multi_row_update: bool = False
 
     @nn.compact
-    def __call__(self, x, caches, positions, cache_index):
+    def __call__(self, x, caches, positions, cache_index, lane_meta=None):
         new_caches = []
         unit_metrics: List[Dict[str, jax.Array]] = []
         for j, off in enumerate(self.offsets):
@@ -206,6 +208,7 @@ class _ScanUnit(nn.Module):
                 positions=positions,
                 kv_cache=None if caches is None else caches[j],
                 cache_index=cache_index,
+                lane_meta=lane_meta,
             )
             new_caches.append(nc)
             if m:
@@ -251,6 +254,7 @@ class LuminaTransformer(nn.Module):
         return_hidden: bool = False,
         prefix_embeds: Optional[jax.Array] = None,
         multi_row_update: bool = False,
+        lane_meta: Optional[Any] = None,
     ):
         cfg = self.config
         embedder = Embedder(cfg, dtype=self.dtype, name="embedder")
@@ -285,7 +289,7 @@ class LuminaTransformer(nn.Module):
         if cfg.scan_layers:
             x, new_caches, all_metrics = self._apply_scanned(
                 x, positions, kv_caches, cache_index, deterministic,
-                remat_on, policy, multi_row_update,
+                remat_on, policy, multi_row_update, lane_meta,
             )
         else:
             block_cls = TransformerBlock
@@ -318,6 +322,7 @@ class LuminaTransformer(nn.Module):
                     positions=positions,
                     kv_cache=cache_i,
                     cache_index=cache_index,
+                    lane_meta=lane_meta,
                 )
                 if decoding:
                     new_caches.append(new_cache)
@@ -346,7 +351,7 @@ class LuminaTransformer(nn.Module):
 
     def _apply_scanned(
         self, x, positions, kv_caches, cache_index, deterministic,
-        remat_on, policy, multi_row_update=False,
+        remat_on, policy, multi_row_update=False, lane_meta=None,
     ):
         """`nn.scan` over homogeneous layer segments (see scan_segments).
 
@@ -370,7 +375,7 @@ class LuminaTransformer(nn.Module):
                 unit_cls,
                 variable_axes={"params": 0},
                 split_rngs={"params": True, "routing": True, "dropout": True},
-                in_axes=(0, nn.broadcast, nn.broadcast),
+                in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast),
                 out_axes=0,
                 length=count,
                 metadata_params={nn.meta.PARTITION_NAME: "layers"},
@@ -384,7 +389,7 @@ class LuminaTransformer(nn.Module):
                 deterministic=deterministic,
                 multi_row_update=multi_row_update,
                 name=f"scan_{s}",
-            )(x, seg_caches, positions, cache_index)
+            )(x, seg_caches, positions, cache_index, lane_meta)
             if decoding:
                 new_caches.append(caches_out)
             if metrics:

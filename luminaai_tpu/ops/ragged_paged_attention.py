@@ -1,0 +1,370 @@
+"""Ragged paged attention for the serving decode path (arxiv 2604.15464).
+
+The continuous-batching pool (inference/kv_pool.py) is slot-paged: each
+lane's KV lives in `pages` tiles of `page_size` rows, addressed through a
+per-lane page table, with a per-lane length saying how many rows are
+actually resident. The dense decode path ignores all of that structure —
+it materializes a `[B, S_cache]` mask over the FULL pool extent every
+step, so decode cost scales with pool capacity instead of tokens
+resident. This module closes that gap with two implementations behind
+one dispatcher:
+
+- `ragged_paged_attention_xla`: pure-XLA reference. Gathers the lane's
+  pages through the page table (skippable when the table is the pool's
+  identity layout — the gather would only copy bytes) and masks by
+  per-lane length. It is the parity oracle for the kernel AND the
+  fallback whenever the kernel is ineligible (odd head_dim/page_size,
+  multi-row q). Callers bound its cost by slicing the page axis to the
+  resident extent before calling (StepwiseDecoder does), so even the
+  fallback reads O(tokens resident), not O(pool capacity).
+
+- `ragged_paged_attention` (Pallas): grid over (lane, head, kv-page)
+  with the page table and lengths as SCALAR-PREFETCH operands — the
+  K/V BlockSpec index maps chase the table directly, pages past a
+  lane's length are clamped to the last live page (a re-fetch Pallas
+  elides) and compute-skipped via `pl.when`, and the running
+  (max, denominator, accumulator) online softmax means no [B, S_cache]
+  score row ever exists. Interpret mode on CPU, compiled on TPU — the
+  same pattern ops/flash_attention.py established.
+
+`LaneMeta` is the lane-metadata struct (lengths, page table, window,
+kind) that ROADMAP item 5 collapses the per-variant attention masking
+behind: models/layers.py threads it through GQAttention, so the
+scalar-offset decode, batched `cache_index` decode, and chunked-prefill
+variants all describe themselves the same way and the ragged kernel is
+a drop-in backend (`config.attention_backend`).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+LANES = 128  # lane-replicated per-row stats, matching flash_attention
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+@struct.dataclass
+class LaneMeta:
+    """Per-lane attention metadata for length-aware decode/prefill.
+
+    lengths: [B] int32 — rows resident per lane INCLUDING rows written
+      by the current call (decode at position p ⇒ lengths = p + 1).
+      0 marks a lane with nothing attendable (its output is garbage the
+      caller must ignore — inactive pool slots during a shared step).
+      None makes the struct a BACKEND HINT only: the attention layer
+      derives lengths/window/page_size itself (from cache_index /
+      positions) and honors just the `backend` field — how an engine
+      whose config differs from the model's construction-time config
+      still decides the backend (the kv_cache_dtype override contract).
+    page_table: [B, P] int32 — logical page j of lane b lives at
+      physical page `page_table[b, j]` of the lane's visible page axis.
+      The pool's layout is the identity table today; the indirection is
+      what page sharing/compaction (prefix caching) will retarget.
+    window: static sliding-window width (None = full causal).
+    kind: static 'decode' (S=1 rows at lengths-1) or 'prefill'
+      (multi-row chunks; q positions come from the `positions` operand).
+    page_size: static rows per page.
+    """
+
+    lengths: Optional[jax.Array] = None
+    page_table: Optional[jax.Array] = None
+    # Static backend override ('dense' | 'ragged_xla' | 'ragged'); None
+    # defers to the model config's attention_backend. The ENGINE config
+    # wins when both exist — callers thread it here.
+    backend: Optional[str] = struct.field(pytree_node=False, default=None)
+    window: Optional[int] = struct.field(pytree_node=False, default=None)
+    kind: str = struct.field(pytree_node=False, default="decode")
+    page_size: int = struct.field(pytree_node=False, default=128)
+    # The pool hands out identity tables (contract-tested); skipping the
+    # XLA reference's physical gather then saves a pool-sized copy per
+    # step. The Pallas kernel always honors the table — its index maps
+    # cost nothing either way.
+    identity_pages: bool = struct.field(pytree_node=False, default=True)
+    # Static resident-extent bound in ROWS (page-aligned): the attention
+    # layer slices the post-write K/V to [:, :extent] before dispatch, so
+    # even the XLA reference reads O(tokens resident) instead of O(pool
+    # capacity). The CALLER picks it from a small power-of-two page
+    # ladder (StepwiseDecoder does) so the executable count stays
+    # O(log pages), mirroring the prompt-bucket discipline. None = full
+    # extent. Every lane's lengths must satisfy lengths <= extent.
+    extent: Optional[int] = struct.field(pytree_node=False, default=None)
+
+
+def ragged_eligible(page_size: int, head_dim: int, s_q: int) -> bool:
+    """When the Pallas decode kernel applies: one q row per lane,
+    sublane-aligned pages, lane-friendly head_dim (Mosaic pads 64→128).
+    Everything else takes the XLA reference path."""
+    return s_q == 1 and page_size % 8 == 0 and head_dim % 64 == 0
+
+
+def implied_page_size(cache_rows: int) -> int:
+    """Page size for a LaneMeta DERIVED inside the attention layer (no
+    pool in sight — scalar-offset decode, bucketed prefill): the largest
+    sublane-aligned power of two dividing the cache extent, capped at
+    128, so the Pallas kernel stays eligible whenever the extent allows
+    it. Falls back to the full extent (kernel ineligible unless it is
+    itself aligned)."""
+    ps = 128
+    while ps >= 8:
+        if cache_rows % ps == 0:
+            return ps
+        ps //= 2
+    return cache_rows
+
+
+# ---------------------------------------------------------------------------
+# Pure-XLA reference (parity oracle + fallback)
+# ---------------------------------------------------------------------------
+def ragged_paged_attention_xla(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    meta: LaneMeta,
+    positions: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Length-masked paged attention, reference semantics.
+
+    q: [B, Sq, Hq, D]; k/v: [B, C, Hkv, D] flat with C == P * page_size
+    (the caller's resident-extent slice). positions: [B, Sq] absolute q
+    positions for prefill chunks (-1 rows are padding and fully masked);
+    decode (Sq == 1) derives the q position from lengths.
+
+    The mask formula is exactly the dense per-lane decode mask
+    (models/layers.py) restricted by residency — greedy streams through
+    this path are token-identical to the dense backend by construction.
+    """
+    B, Sq, n_q, d = q.shape
+    C, n_kv = k.shape[1], k.shape[2]
+    ps = meta.page_size
+    if meta.page_table is not None and not meta.identity_pages:
+        # Physical gather through the page table: [B, P] page ids pick
+        # pages off the lane's own page axis. Identity tables skip this
+        # (the values would be bit-identical; the copy would not be free).
+        P = C // ps
+        table = meta.page_table[:, :P]
+        paged = k.reshape(B, P, ps, n_kv, d)
+        k = jnp.take_along_axis(
+            paged, table[:, :, None, None, None], axis=1
+        ).reshape(B, C, n_kv, d)
+        paged_v = v.reshape(B, P, ps, n_kv, d)
+        v = jnp.take_along_axis(
+            paged_v, table[:, :, None, None, None], axis=1
+        ).reshape(B, C, n_kv, d)
+
+    g = n_q // n_kv
+    qg = q.reshape(B, Sq, n_kv, g, d)
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    logits = (
+        jnp.einsum("bqhgd,bkhd->bhgqk", qg, k).astype(jnp.float32) * scale
+    )
+
+    if positions is not None:
+        qp = positions[:, :, None]  # [B, Sq, 1]; -1 rows mask everything
+    else:
+        qp = (meta.lengths[:, None, None] - Sq) + jnp.arange(Sq)[None, :, None]
+    kp = jnp.arange(C)[None, None, :]
+    mask = jnp.logical_and(kp <= qp, kp < meta.lengths[:, None, None])
+    if meta.window is not None:
+        mask = jnp.logical_and(mask, qp - kp < meta.window)
+    logits = jnp.where(mask[:, None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)
+    return out.reshape(B, Sq, n_q, d)
+
+
+# ---------------------------------------------------------------------------
+# Pallas decode kernel: grid (lane, q head, kv page), page-table-native
+# ---------------------------------------------------------------------------
+def _decode_kernel(
+    lengths_ref,  # scalar prefetch [B]
+    table_ref,  # scalar prefetch [B, P]
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    m_scr,
+    l_scr,
+    acc_scr,
+    *,
+    scale,
+    page_size,
+    window,
+):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    length = lengths_ref[b]
+    # One q row per lane at position length-1. Pages wholly past the
+    # length (and, under a window, wholly before the band) cost neither
+    # compute nor a fresh DMA — the index map below pins skipped steps
+    # to an already-fetched page.
+    page_start = j * page_size
+    needed = page_start < length
+    if window:
+        needed = jnp.logical_and(
+            needed, page_start + page_size - 1 >= length - window
+        )
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0, :, :]  # [1, D]
+        k = k_ref[0, 0, 0, :, :]  # [page_size, D]
+        v = v_ref[0, 0, 0, :, :]
+        s = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # [1, page_size] fp32
+        kp = page_start + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1
+        )
+        keep = kp < length
+        if window:
+            keep = jnp.logical_and(keep, (length - 1) - kp < window)
+        s = jnp.where(keep, s, NEG_INF)
+        m_prev = m_scr[:, :]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1)[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, :1])
+        l_scr[:, :] = l_scr[:, :] * alpha + jnp.sum(p, axis=-1)[:, None]
+        acc_scr[:] = acc_scr[:] * alpha[:, :1] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:, :] = m_new
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        l = l_scr[:, :]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0, :, :] = (acc_scr[:] / safe_l[:, :1]).astype(o_ref.dtype)
+
+
+def _page_index_map(group, page_size, n_pages, window):
+    """K/V BlockSpec index map: chase the page table for live pages,
+    clamp skipped grid steps onto the lane's last live page (same block
+    index as a neighbouring step ⇒ Pallas skips the DMA entirely)."""
+
+    def index(b, h, j, lengths, table):
+        length = lengths[b]
+        last = jnp.maximum(length - 1, 0) // page_size
+        first = 0
+        if window:
+            first = jnp.maximum(length - window, 0) // page_size
+        jv = jnp.clip(j, first, last)
+        phys = table[b, jnp.minimum(jv, n_pages - 1)]
+        return (b, h // group, phys, 0, 0)
+
+    return index
+
+
+def ragged_paged_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    meta: LaneMeta,
+) -> jax.Array:
+    """Pallas page-table-native decode attention.
+
+    q: [B, 1, Hq, D]; k/v: [B, C, Hkv, D] flat, C == P * meta.page_size.
+    Returns [B, 1, Hq, D]. Gate with ragged_eligible(); interpret mode
+    off-TPU (CPU tests), compiled on TPU.
+    """
+    B, Sq, Hq, D = q.shape
+    C, Hkv = k.shape[1], k.shape[2]
+    ps = meta.page_size
+    assert Sq == 1, "the Pallas kernel is decode-shaped (one q row/lane)"
+    assert C % ps == 0, (C, ps)
+    P = C // ps
+    group = Hq // Hkv
+
+    lengths = meta.lengths.astype(jnp.int32)
+    if meta.page_table is not None:
+        table = meta.page_table.astype(jnp.int32)[:, :P]
+    else:
+        table = jnp.tile(jnp.arange(P, dtype=jnp.int32)[None], (B, 1))
+
+    qt = q.transpose(0, 2, 1, 3)  # [B, Hq, 1, D]
+    kt = k.reshape(B, P, ps, Hkv, D).transpose(0, 3, 1, 2, 4)
+    vt = v.reshape(B, P, ps, Hkv, D).transpose(0, 3, 1, 2, 4)
+
+    window = int(meta.window or 0)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, Hq, P),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, 1, D), lambda b, h, j, lengths, table: (b, h, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, 1, ps, D), _page_index_map(group, ps, P, window)
+            ),
+            pl.BlockSpec(
+                (1, 1, 1, ps, D), _page_index_map(group, ps, P, window)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, 1, D), lambda b, h, j, lengths, table: (b, h, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((1, LANES), jnp.float32),
+            pltpu.VMEM((1, LANES), jnp.float32),
+            pltpu.VMEM((1, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _decode_kernel,
+            scale=1.0 / (D**0.5),
+            page_size=ps,
+            window=window,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hq, 1, D), q.dtype),
+        interpret=_interpret(),
+    )(lengths, table, qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)
+
+
+def paged_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    meta: LaneMeta,
+    *,
+    backend: str = "ragged",
+    positions: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Backend dispatcher (config.attention_backend):
+
+    'ragged'      Pallas kernel when eligible, XLA reference otherwise
+    'ragged_xla'  always the XLA reference (the CPU-serving default —
+                  interpret-mode kernels cost interpreter time)
+
+    Prefill chunks (Sq > 1) always take the reference path; the kernel
+    is decode-specialized.
+    """
+    Sq, D = q.shape[1], q.shape[3]
+    if backend == "ragged" and ragged_eligible(meta.page_size, D, Sq):
+        return ragged_paged_attention(q, k, v, meta)
+    return ragged_paged_attention_xla(q, k, v, meta, positions=positions)

@@ -47,6 +47,7 @@ dependency-free and testable in-process.
 
 from __future__ import annotations
 
+import inspect
 import json
 import logging
 import queue
@@ -249,16 +250,36 @@ class ContinuousScheduler:
         recorder: Optional[FlightRecorder] = None,
         max_tenants: int = 64,
         tick_every: int = 16,
+        prefill_chunk_tokens: Optional[int] = None,
     ):
         self.engine = engine
         # Default per-request deadline; a request's own timeout_s can only
         # shorten it. None = no deadline unless the request asks for one.
         self.request_timeout_s = request_timeout_s
-        self.decoder = decoder or engine.make_stepwise(
-            num_slots=num_slots,
-            page_size=page_size,
-            max_slot_tokens=max_slot_tokens,
-        )
+        if decoder is None:
+            kw = dict(
+                num_slots=num_slots,
+                page_size=page_size,
+                max_slot_tokens=max_slot_tokens,
+            )
+            # Duck-typed engines may predate the chunked-prefill kwarg:
+            # inspect the signature instead of catching TypeError, which
+            # would also swallow genuine constructor errors.
+            try:
+                accepts_chunk = "prefill_chunk_tokens" in (
+                    inspect.signature(engine.make_stepwise).parameters
+                )
+            except (TypeError, ValueError):
+                accepts_chunk = False
+            if accepts_chunk:
+                kw["prefill_chunk_tokens"] = prefill_chunk_tokens
+            decoder = engine.make_stepwise(**kw)
+        self.decoder = decoder
+        # Admissions mid-prefill: slot -> (request, decoder chunk state,
+        # admission timestamp). The worker advances ONE chunk per loop
+        # tick, interleaved with decode steps, so a long prompt cannot
+        # stall concurrent lanes for more than ~one chunk's step time.
+        self._prefilling: Dict[int, Tuple[Any, Any, float, float]] = {}
         self.q: "queue.Queue" = queue.Queue()
         self.window = max(0.0, float(admission_window_ms)) / 1000.0
         # Stat names shared with MicroBatcher so /stats stays stable:
@@ -336,6 +357,11 @@ class ContinuousScheduler:
             "serving_requests_timed_out_total",
             "Requests evicted (or refused admission) because their "
             "deadline passed before completion",
+        )
+        self._m_prefill_chunks = r.counter(
+            "serving_prefill_chunks_total",
+            "Prefill chunks executed by the scheduler (chunked prefill "
+            "interleaves these with decode steps)",
         )
         # Per-tenant accounting (bounded: max_tenants distinct labels,
         # then the registry's `_overflow` bucket — a tenant label can
@@ -455,6 +481,7 @@ class ContinuousScheduler:
             "decode_steps": int(getattr(self.decoder, "steps", 0)),
             "active_lanes": self._active_lanes,
             "queue_depth": self.queue_depth(),
+            "prefilling": len(self._prefilling),
         }
         pool = getattr(self.decoder, "pool", None)
         if pool is not None and hasattr(pool, "stats"):
@@ -628,6 +655,29 @@ class ContinuousScheduler:
             prompt_tokens=len(req.prompt),
             step=int(getattr(self.decoder, "steps", 0)),
         )
+        start = getattr(self.decoder, "start_prefill", None)
+        if start is not None and getattr(self.decoder, "prefill_chunk", 0):
+            try:
+                st = start(
+                    slot,
+                    req.prompt,
+                    max_new_tokens=req.max_new,
+                    sample_key=req.sample_key,
+                    seed=req.seed,
+                )
+            except Exception as e:
+                logger.exception("start-prefill failed")
+                self._release_slot(slot)
+                self._fail(req, e)
+                return
+            if st is not None:
+                # Chunks run from the worker loop, one per tick,
+                # interleaved with decode steps (_advance_prefills). The
+                # trailing 0.0 accumulates per-chunk compute seconds so
+                # serve_prefill_seconds stays a prefill-cost histogram
+                # rather than absorbing every interleaved decode tick.
+                self._prefilling[slot] = (req, st, t_admit, 0.0)
+                return
         try:
             with self.tracer.span(
                 "prefill", slot=slot, prompt_tokens=len(req.prompt)
@@ -644,16 +694,27 @@ class ContinuousScheduler:
             self._release_slot(slot)
             self._fail(req, e)
             return
+        self._prefill_done(req, slot, info, t_admit, active)
+
+    def _prefill_done(self, req, slot, info, t_admit, active,
+                      prefill_s=None) -> None:
+        """Shared prompt-prefilled tail for the whole-prompt and chunked
+        admission paths: TTFT booking, first-token emission, lane
+        activation (or immediate finish). `prefill_s` is the prefill
+        COMPUTE time — the chunked path passes its per-chunk sum so the
+        histogram keeps one meaning across both admission paths (the
+        monolithic path's admission-to-done wall time IS its compute)."""
         ttft = max(0.0, time.time() - req.t0)
+        if prefill_s is None:
+            prefill_s = time.perf_counter() - t_admit
         if self.telemetry:
-            now = time.perf_counter()
-            self._m_prefill.observe(now - t_admit)
+            self._m_prefill.observe(prefill_s)
             # First token is sampled inside prefill, so TTFT lands here.
             self._m_ttft.observe(ttft)
             self._m_tenant_ttft.labels(tenant=req.tenant).observe(ttft)
         self._event(
             "request_prefill", req, slot=slot,
-            prefill_s=round(time.perf_counter() - t_admit, 4),
+            prefill_s=round(prefill_s, 4),
             prompt_tokens=int(info.get("prompt_tokens", 0)),
         )
         self._event("request_first_token", req, slot=slot,
@@ -687,6 +748,49 @@ class ContinuousScheduler:
                 self._admit(nxt, active)
             else:
                 self._pending.append(nxt)
+
+    def _advance_prefills(self, active: dict) -> None:
+        """Advance ONE chunk of ONE mid-prefill admission (round-robin
+        in admission order). Called once per scheduler tick, so prefill
+        work interleaves with decode steps instead of stalling them —
+        the chunked-prefill latency contract (docs/serving.md)."""
+        if not self._prefilling:
+            return
+        slot, (req, st, t_admit, spent) = next(
+            iter(self._prefilling.items())
+        )
+        del self._prefilling[slot]
+        if req.cancelled:
+            self._finish(req, "cancelled")
+            self._release_slot(slot)
+            return
+        if req.deadline is not None and time.time() > req.deadline:
+            self._timeout(req, "mid-prefill")
+            self._release_slot(slot)
+            return
+        try:
+            t_chunk = time.perf_counter()
+            with self.tracer.span("prefill_chunk", slot=slot):
+                info = self.decoder.advance_prefill(st)
+            spent += time.perf_counter() - t_chunk
+        except Exception as e:
+            logger.exception("chunked prefill failed")
+            self._release_slot(slot)
+            self._fail(req, e)
+            return
+        if self.telemetry:
+            self._m_prefill_chunks.inc()
+        self._event(
+            "prefill_chunk", req, slot=slot,
+            chunk=int(st["next"]), chunks=int(st["n_chunks"]),
+            rows=int(min(st["next"] * st["chunk"], st["length"])),
+        )
+        if info is None:
+            # More chunks pending: back of the round-robin ring.
+            self._prefilling[slot] = (req, st, t_admit, spent)
+            return
+        self._prefill_done(req, slot, info, t_admit, active,
+                           prefill_s=spent)
 
     def _loop(self) -> None:
         while True:
@@ -732,9 +836,15 @@ class ContinuousScheduler:
         # (per-step events would be all the ring buffer ever holds).
         tick_steps = tick_tokens = 0
         tick_t0 = time.perf_counter()
-        while active:
+        while active or self._prefilling:
             self._admit_queued(key, active)
+            # One prefill chunk per tick: a long admission progresses
+            # without ever costing the decode batch more than one
+            # chunk-sized forward between steps.
+            self._advance_prefills(active)
             if not active:
+                if self._prefilling:
+                    continue
                 break
             try:
                 t_step = time.perf_counter()
@@ -745,6 +855,10 @@ class ContinuousScheduler:
                 for r in list(active.values()):
                     self._fail(r, e)
                     self._release(r, active)
+                for slot, (r, *_) in list(self._prefilling.items()):
+                    self._fail(r, e)
+                    self._release_slot(slot)
+                self._prefilling.clear()
                 return
             n_produced = sum(1 for slot in active if produced[slot])
             if self.telemetry:
@@ -857,6 +971,7 @@ class ChatServer:
         flight_dir: Optional[str] = None,
         max_tenants: int = 64,
         recorder: Optional[FlightRecorder] = None,
+        prefill_chunk_tokens: Optional[int] = None,
     ):
         self.engine = engine
         self.telemetry = bool(telemetry)
@@ -905,6 +1020,7 @@ class ChatServer:
                 request_timeout_s=request_timeout_s,
                 recorder=self.recorder,
                 max_tenants=self.max_tenants,
+                prefill_chunk_tokens=prefill_chunk_tokens,
             )
         else:
             self.batcher = MicroBatcher(
@@ -1905,6 +2021,7 @@ def serve(
     drain_grace_s: float = 30.0,
     flight_dir: Optional[str] = None,
     max_tenants: int = 64,
+    prefill_chunk_tokens: Optional[int] = None,
 ):
     """Build an engine from a checkpoint and serve it (CLI `serve`)."""
     from luminaai_tpu.inference.chat import ChatInterface
@@ -1922,6 +2039,7 @@ def serve(
         chat.engine, secure=secure, bootstrap_user=bootstrap_user,
         continuous=continuous, num_slots=num_slots, page_size=page_size,
         admission_window_ms=admission_window_ms,
+        prefill_chunk_tokens=prefill_chunk_tokens,
         telemetry=telemetry,
         tracer=tracer,
         request_timeout_s=request_timeout_s,

@@ -441,21 +441,23 @@ def surface_report():
 
 
 def test_recompile_surface_pins_current_counts(surface_report):
-    """THE baseline number for ROADMAP item 5: today the enumerated
-    scenarios compile to 8 distinct executables (train: scan on/off x
-    gmm/einsum = 4; decode: 2 prefill buckets + scalar-offset + batched
-    cache_index = 4). The unified-forward refactor exists to reduce
-    this — when it lands, lower these pins deliberately. If a change
-    RAISES them, a new forked variant slipped into the hot path."""
+    """THE baseline number for ROADMAP item 5: the enumerated scenarios
+    compile to 7 distinct executables (train: scan on/off x gmm/einsum
+    = 4; decode: 2 prompt-length scenarios sharing ONE chunked-prefill
+    executable + scalar-offset + batched cache_index = 3). The LaneMeta
+    unification took decode from 4 to 3 by collapsing the prefill
+    bucket ladder; further reductions lower these pins deliberately. If
+    a change RAISES them, a new forked variant slipped into the hot
+    path."""
     report, _ = surface_report
     train = report["programs"]["train"]
     decode = report["programs"]["decode"]
     assert len(train["variants"]) == 4
     assert train["distinct_signatures"] == 4
     assert len(decode["variants"]) == 4
-    assert decode["distinct_signatures"] == 4
+    assert decode["distinct_signatures"] == 3
     assert report["total_variants"] == 8
-    assert report["total_distinct"] == 8
+    assert report["total_distinct"] == 7
 
 
 def test_recompile_surface_hot_paths_have_no_host_transfers(surface_report):
@@ -475,9 +477,11 @@ def test_recompile_surface_exports_gauges(surface_report):
     assert "analysis_host_transfer_ops" in text
 
 
-def test_prefill_buckets_are_distinct_executables(surface_report):
-    """Bucketed prefill is a per-bucket executable — the enumerator
-    must see through the shared factory and count each bucket."""
+def test_prefill_scenarios_share_one_chunked_executable(surface_report):
+    """Chunked prefill feeds every prompt length through one fixed-chunk
+    step: the enumerated prompt-length scenarios must collapse to a
+    SINGLE signature (the inversion of the old per-bucket pin — under
+    the bucket ladder these were two executables)."""
     report, _ = surface_report
     sigs = {
         v["variant"]: v["signature"]
@@ -485,7 +489,7 @@ def test_prefill_buckets_are_distinct_executables(surface_report):
         if v["variant"].startswith("prefill/")
     }
     assert len(sigs) == 2
-    assert len(set(sigs.values())) == 2
+    assert len(set(sigs.values())) == 1
 
 
 def test_sharding_coverage_full_on_cpu_mesh():
