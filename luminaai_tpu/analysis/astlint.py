@@ -1,4 +1,4 @@
-"""AST lint engine: repo-specific JAX correctness rules (LX001..LX008).
+"""AST lint engine: repo-specific JAX correctness rules (LX001..LX009).
 
 A small, dependency-free rule framework over `ast`: each rule is a
 callable over a parsed file that yields findings; the engine applies
@@ -18,6 +18,8 @@ narrow-scope (precise on THIS codebase) rather than general-purpose:
   LX006  step-shaped jit without buffer donation
   LX007  mutable default pytrees on nn.Module fields
   LX008  bare `except:` that would swallow XlaRuntimeError
+  LX009  tenant-labeled metric family without a max_label_values
+         budget (unbounded /metrics cardinality)
 
 The jit-context detector (which functions end up traced) is shared by
 LX002/LX003/LX004 and intentionally over-approximates: decorated
@@ -864,6 +866,57 @@ def _check_lx008(ctx: FileContext) -> Iterator[Finding]:
 
 
 # --------------------------------------------------------------------------
+# LX009 — tenant-labeled metric family without a label-value budget
+# --------------------------------------------------------------------------
+
+
+def _labelnames_has_tenant(value: ast.AST) -> bool:
+    if isinstance(value, (ast.Tuple, ast.List)):
+        return any(
+            isinstance(e, ast.Constant) and e.value == "tenant"
+            for e in value.elts
+        )
+    return False
+
+
+def _check_lx009(ctx: FileContext) -> Iterator[Finding]:
+    """Tenant-keyed metric families are unbounded-cardinality hazards:
+    every family carrying a 'tenant' label MUST declare a
+    max_label_values budget (the registry then collapses the overflow
+    into `_overflow`), so tenant-keyed series — request accounting,
+    prefix-cache residency — ride under the server's --max-tenants
+    bound instead of letting one scan mint unbounded /metrics series.
+    Covers the direct registration call and the shared-kwargs dict
+    idiom (tk = dict(labelnames=("tenant",), ...))."""
+    msg = (
+        "metric family labeled by 'tenant' without a max_label_values "
+        "budget — tenant cardinality must be bounded (--max-tenants) "
+        "or one tenant scan explodes /metrics"
+    )
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            kws = {k.arg: k.value for k in node.keywords if k.arg}
+            if "labelnames" in kws and _labelnames_has_tenant(
+                kws["labelnames"]
+            ):
+                if "max_label_values" not in kws:
+                    yield ctx.finding(LX009, node, msg)
+        elif isinstance(node, ast.Dict):
+            keys = [
+                k.value for k in node.keys
+                if isinstance(k, ast.Constant)
+            ]
+            if "labelnames" in keys and "max_label_values" not in keys:
+                for k, v in zip(node.keys, node.values):
+                    if (
+                        isinstance(k, ast.Constant)
+                        and k.value == "labelnames"
+                        and _labelnames_has_tenant(v)
+                    ):
+                        yield ctx.finding(LX009, node, msg)
+
+
+# --------------------------------------------------------------------------
 # registry / engine
 # --------------------------------------------------------------------------
 
@@ -907,9 +960,14 @@ LX008 = Rule(
     "bare except swallowing XlaRuntimeError",
     _check_lx008,
 )
+LX009 = Rule(
+    "LX009", "tenant-label-budget", SEVERITY_ERROR,
+    "tenant-labeled metric family without max_label_values budget",
+    _check_lx009,
+)
 
 ALL_RULES: Tuple[Rule, ...] = (
-    LX001, LX002, LX003, LX004, LX005, LX006, LX007, LX008,
+    LX001, LX002, LX003, LX004, LX005, LX006, LX007, LX008, LX009,
 )
 
 RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in ALL_RULES}

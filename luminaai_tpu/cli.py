@@ -684,6 +684,12 @@ def cmd_serve(args) -> int:
         drain_grace_s=getattr(args, "drain_grace_s", 30.0),
         flight_dir=getattr(args, "flight_dir", None),
         prefill_chunk_tokens=getattr(args, "prefill_chunk_tokens", None),
+        prefix_cache_pages=getattr(args, "prefix_cache_pages", None),
+        prefix_cache_tenant_quota=getattr(
+            args, "prefix_cache_tenant_quota", None
+        ),
+        tenant_rate_per_s=getattr(args, "tenant_rate_per_s", None),
+        tenant_burst=getattr(args, "tenant_burst", None),
     )
     return 0
 
@@ -1120,6 +1126,7 @@ def cmd_events(args) -> int:
     total = len(events)
     events = filter_events(
         events, type=args.etype, grep=args.grep,
+        request=getattr(args, "request_id", None),
         tail=args.tail if args.tail else None,
     )
     if args.json:
@@ -1424,6 +1431,29 @@ def build_parser() -> argparse.ArgumentParser:
                     help="where drain dumps the wide-event flight record "
                          "(flightrec-*.jsonl; default: the checkpoint "
                          "dir, else the working dir)")
+    sv.add_argument("--prefix-cache-pages", dest="prefix_cache_pages",
+                    type=int, default=None,
+                    help="radix prefix cache budget in KV pool pages: "
+                         "admissions splice cached shared-prefix pages "
+                         "(system prompts, few-shot templates) instead "
+                         "of re-prefilling them; LRU-evicted beyond the "
+                         "budget (default: the config's "
+                         "prefix_cache_pages; 0 disables)")
+    sv.add_argument("--prefix-cache-tenant-quota",
+                    dest="prefix_cache_tenant_quota", type=int,
+                    default=None,
+                    help="max cached pages one tenant may own — at "
+                         "quota a tenant evicts its OWN pages, never "
+                         "other tenants' (0 = unbounded)")
+    sv.add_argument("--tenant-rate", dest="tenant_rate_per_s",
+                    type=float, default=None,
+                    help="per-tenant token-bucket admission rate "
+                         "(requests/sec refill; unset disables the "
+                         "bucket gate)")
+    sv.add_argument("--tenant-burst", dest="tenant_burst", type=int,
+                    default=None,
+                    help="per-tenant token-bucket burst capacity "
+                         "(default: ~1s of --tenant-rate)")
     sv.set_defaults(fn=cmd_serve)
 
     b = sub.add_parser("benchmark", help="run the bench harness")
@@ -1513,6 +1543,11 @@ def build_parser() -> argparse.ArgumentParser:
     ev.add_argument("--grep", help="regex over the serialized record")
     ev.add_argument("--type", dest="etype",
                     help="only events of this type (e.g. request_admitted)")
+    ev.add_argument("--request", dest="request_id",
+                    help="only events of one request id: its full "
+                         "lifecycle (admission -> prefix_hit -> chunks "
+                         "-> completion) — the cache-splice debugging "
+                         "loop")
     ev.add_argument("--json", action="store_true",
                     help="one JSON record per line (pipe into jq)")
     ev.set_defaults(fn=cmd_events)

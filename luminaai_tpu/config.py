@@ -88,6 +88,17 @@ class Config:
     # (legacy bucketed prefill). Engines with a rolling windowed cache
     # ignore it (chunk writes are only defined on non-wrapping layouts).
     prefill_chunk_size: int = 64
+    # Radix prefix cache over the serving KV pool (inference/
+    # prefix_cache.py): budget of content-hash-keyed arena pages shared
+    # copy-on-write across lanes — admissions splice the longest cached
+    # prompt-prefix page chain into their page table and prefill only
+    # the uncached suffix. 0 disables. Requires a ragged attention
+    # backend (the dense mask cannot follow cross-slot aliases; the
+    # decoder gates the cache off under 'dense') and chunked prefill.
+    prefix_cache_pages: int = 0
+    # Max arena pages one tenant may own (0 = unbounded): a hot tenant
+    # at quota evicts its OWN pages, never everyone else's.
+    prefix_cache_tenant_quota: int = 0
     # Sliding-window (local) attention: each position attends to at most
     # the `attention_window` most recent positions (itself included).
     # None = full causal. The flash kernels skip whole blocks outside the
@@ -411,6 +422,12 @@ class Config:
         )
         assert self.prefill_chunk_size >= 0, (
             "prefill_chunk_size must be >= 0 (0 disables chunked prefill)"
+        )
+        assert self.prefix_cache_pages >= 0, (
+            "prefix_cache_pages must be >= 0 (0 disables the prefix cache)"
+        )
+        assert self.prefix_cache_tenant_quota >= 0, (
+            "prefix_cache_tenant_quota must be >= 0 (0 = unbounded)"
         )
         if self.attention_window is not None:
             assert self.attention_window > 0, (

@@ -19,6 +19,7 @@ XLA rather than translated:
 from __future__ import annotations
 
 import functools
+import logging
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -27,6 +28,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from luminaai_tpu.config import Config
+
+logger = logging.getLogger(__name__)
 
 NEG_INF = -1e30
 
@@ -1001,6 +1004,8 @@ class GenerationEngine:
         page_size: int = 128,
         max_slot_tokens: Optional[int] = None,
         prefill_chunk_tokens: Optional[int] = None,
+        prefix_cache_pages: Optional[int] = None,
+        prefix_cache_tenant_quota: Optional[int] = None,
     ) -> "StepwiseDecoder":
         """Build a StepwiseDecoder: the scheduler-owned decode API
         (prefill_into_slot + decode_step) continuous batching runs on.
@@ -1013,6 +1018,8 @@ class GenerationEngine:
             page_size=page_size,
             max_slot_tokens=max_slot_tokens,
             prefill_chunk_tokens=prefill_chunk_tokens,
+            prefix_cache_pages=prefix_cache_pages,
+            prefix_cache_tenant_quota=prefix_cache_tenant_quota,
         )
 
 
@@ -1054,6 +1061,8 @@ class StepwiseDecoder:
         page_size: int = 128,
         max_slot_tokens: Optional[int] = None,
         prefill_chunk_tokens: Optional[int] = None,
+        prefix_cache_pages: Optional[int] = None,
+        prefix_cache_tenant_quota: Optional[int] = None,
     ):
         from luminaai_tpu.inference.kv_pool import PagedKVPool, to_paged
 
@@ -1064,12 +1073,55 @@ class StepwiseDecoder:
         page_size = max(1, int(page_size))
         pages = max(1, -(-cap // page_size))
         num_slots = max(1, int(num_slots))
+        # Radix prefix cache (inference/prefix_cache.py): a budget of
+        # arena pages, carved out as extra pool slots PAST the lane
+        # range, holds content-hashed prompt pages that admissions splice
+        # into their global page tables instead of re-prefilling. None ->
+        # the engine config's prefix_cache_pages; 0 disables.
+        if prefix_cache_pages is None:
+            prefix_cache_pages = int(
+                getattr(engine.config, "prefix_cache_pages", 0) or 0
+            )
+        if prefix_cache_tenant_quota is None:
+            prefix_cache_tenant_quota = int(
+                getattr(engine.config, "prefix_cache_tenant_quota", 0) or 0
+            )
+        backend = getattr(engine.config, "attention_backend", "dense")
+        if prefix_cache_pages > 0 and backend == "dense":
+            # The dense per-lane mask reads only the lane's own rows — it
+            # cannot follow a cross-slot page alias. Gated off rather
+            # than silently serving stale rows (docs/serving.md).
+            logger.warning(
+                "prefix cache disabled: attention_backend='dense' cannot "
+                "read shared pages (use ragged_xla/ragged)"
+            )
+            prefix_cache_pages = 0
+        _chunk_eff = (
+            int(prefill_chunk_tokens)
+            if prefill_chunk_tokens is not None
+            else int(getattr(engine.config, "prefill_chunk_size", 0) or 0)
+        )
+        if prefix_cache_pages > 0 and _chunk_eff <= 0:
+            # The suffix-only prefill rides the chunked executables; a
+            # cache without chunking has no splice path.
+            logger.warning(
+                "prefix cache disabled: chunked prefill is off "
+                "(prefill_chunk_tokens=0)"
+            )
+            prefix_cache_pages = 0
+        arena_slots = -(-prefix_cache_pages // pages) if (
+            prefix_cache_pages > 0
+        ) else 0
+        self.total_slots = num_slots + arena_slots
         caches = engine.model.init_cache(
-            num_slots,
+            self.total_slots,
             pages * page_size,
             kv_cache_dtype=getattr(engine.config, "kv_cache_dtype", None),
             rolling=False,
         )
+        # Lane accounting covers ONLY the first num_slots rows; the arena
+        # slots are never allocatable — their pages are addressed purely
+        # through global page-table entries.
         self.pool = PagedKVPool(
             to_paged(caches, pages, page_size),
             num_slots=num_slots,
@@ -1115,16 +1167,75 @@ class StepwiseDecoder:
         self.prefill_chunk = max(
             0, min(int(prefill_chunk_tokens), self.token_capacity)
         )
+        self.prefix_cache = None
+        if arena_slots > 0:
+            from luminaai_tpu.inference.prefix_cache import RadixPrefixCache
+
+            arena_ids = [
+                (num_slots + a) * pages + p
+                for a in range(arena_slots)
+                for p in range(pages)
+            ][:max(prefix_cache_pages, 1)]
+            self.prefix_cache = RadixPrefixCache(
+                arena_ids,
+                page_size=page_size,
+                tenant_quota=prefix_cache_tenant_quota,
+            )
+        # Global page table [num_slots, pages]: entry (s, j) is the
+        # GLOBAL pool page id (slot * pages + page) logical page j of
+        # lane s reads through. Identity (own pages) except where a
+        # prefix splice retargets a lane's matched prefix onto shared
+        # arena pages. Authoritative only when the prefix cache is on —
+        # without it the pool's per-slot LOCAL identity table keeps the
+        # PR-8 contract (and its no-alias tests) unchanged.
+        self._gtable = (
+            np.arange(num_slots, dtype=np.int32)[:, None] * pages
+            + np.arange(pages, dtype=np.int32)[None, :]
+        )
+        # Arena page ids each lane currently references (released with
+        # the slot in release_slot -> refcounts drop, pages survive).
+        self._leases: Dict[int, List[int]] = {}
+        self._refresh_table()
+
+    def _refresh_table(self) -> None:
+        """Device copy of the authoritative page table: the decoder's
+        global table when the prefix cache is on (splices retarget it),
+        the pool's local identity table otherwise (PR-8 contract)."""
+        if self.prefix_cache is not None:
+            self._table = jnp.asarray(self._gtable)
+        else:
+            self._table = jnp.asarray(self.pool.page_table_array())
+
+    def _reset_gtable_row(self, slot: int) -> None:
+        self._gtable[slot] = (
+            slot * self.pool.pages
+            + np.arange(self.pool.pages, dtype=np.int32)
+        )
 
     # -- slot lifecycle ----------------------------------------------------
     def has_free_slot(self) -> bool:
         return self.pool.has_free()
 
     def acquire_slot(self) -> int:
-        return self.pool.alloc()
+        slot = self.pool.alloc()
+        if self.prefix_cache is not None:
+            # Fresh occupants start from identity; a prefix splice
+            # retargets entries AFTER acquire, never across realloc.
+            self._reset_gtable_row(slot)
+            self._refresh_table()
+        return slot
 
     def release_slot(self, slot: int) -> None:
         self._active[slot] = False
+        if self.prefix_cache is not None:
+            # Refcounted release: the lane's spliced arena pages drop
+            # their pin (they stay cached — shared pages survive lane
+            # eviction) and the lane's table row tombstones back to
+            # identity so a stale alias can never ride into the next
+            # occupant.
+            self.prefix_cache.release(self._leases.pop(slot, []))
+            self._reset_gtable_row(slot)
+            self._refresh_table()
         self.pool.free(slot)
 
     def active_count(self) -> int:
@@ -1232,7 +1343,8 @@ class StepwiseDecoder:
         return min(p, self.pool.pages) * ps
 
     def _get_step(self, sample_key, extent: Optional[int] = None):
-        key = ("step", sample_key, self.backend, extent)
+        use_global = self.prefix_cache is not None
+        key = ("step", sample_key, self.backend, extent, use_global)
         if key not in self._fns:
             temperature, top_k, top_p, rep_penalty = sample_key
             stop_ids = jnp.asarray(
@@ -1259,6 +1371,11 @@ class StepwiseDecoder:
                     # 0 marks lanes with nothing attendable (free or
                     # mid-chunked-prefill slots) whose output is garbage
                     # the host discards via `active`.
+                    # With the prefix cache on, table entries are GLOBAL
+                    # (slot, page) ids and the attention gather chases
+                    # them across slots — a lane's matched prefix reads
+                    # the shared arena pages in place (identity_pages
+                    # must be off: the gather is real).
                     meta = LaneMeta(
                         lengths=jnp.where(active, pos + 1, 0).astype(
                             jnp.int32
@@ -1269,6 +1386,8 @@ class StepwiseDecoder:
                         page_size=page_size,
                         extent=extent,
                         backend=backend,
+                        identity_pages=not use_global,
+                        global_pages=use_global,
                     )
                 logits, flat, _ = self.model.apply(
                     {"params": params},
@@ -1337,7 +1456,7 @@ class StepwiseDecoder:
         self.pool.caches = self._get_insert()(
             self.pool.caches, fresh, jnp.asarray(slot, jnp.int32)
         )
-        self._table = jnp.asarray(self.pool.page_table_array())
+        self._refresh_table()
         return self._finish_prefill(slot, logits, L, max_new, sample_key,
                                     seed)
 
@@ -1438,11 +1557,20 @@ class StepwiseDecoder:
         max_new_tokens: int = 1,
         sample_key: Optional[Tuple] = None,
         seed: Optional[int] = None,
+        tenant: str = "anon",
     ) -> Optional[Dict[str, Any]]:
         """Begin a CHUNKED prefill into `slot`. Returns a host-side
         state dict for advance_prefill, or None when chunking is
         disabled (callers fall back to prefill_into_slot). The lane
-        stays inactive until the final chunk activates it."""
+        stays inactive until the final chunk activates it.
+
+        With the prefix cache on, the longest cached page chain for this
+        prompt is PINNED and spliced into the lane's global page table
+        here — chunked prefill then runs only over the uncached suffix,
+        so a cached 1000-token system prompt costs zero prefill FLOPs.
+        At least one row is always recomputed (the last prompt row must
+        produce logits to sample token #1), so a fully-cached prompt
+        still runs one chunk."""
         if not self.prefill_chunk:
             return None
         sample_key = sample_key or GREEDY_SAMPLE_KEY
@@ -1454,29 +1582,63 @@ class StepwiseDecoder:
         )
         L = len(prompt)
         chunk = self.prefill_chunk
-        if L <= chunk:
+        ps = self.pool.page_size
+        hit_ids: List[int] = []
+        hit_rows = 0
+        if self.prefix_cache is not None:
+            from luminaai_tpu.inference.prefix_cache import page_chain_keys
+
+            # One chained hash of the prompt per admission, shared by
+            # the peek and the pin below. The peek counts NOTHING: short
+            # cold prompts fall back to the monolithic path, and a miss
+            # booked for an admission the cache never served would make
+            # cache.stats() disagree with serve_prefix_cache_misses_total.
+            chain = page_chain_keys(
+                prompt, self.pool.page_size, (L - 1) // ps
+            )
+            peek_keys, _ = self.prefix_cache.lookup(prompt, keys=chain)
+            if L <= chunk and not peek_keys:
+                return None
+            # Pin before splicing: an acquired page cannot be evicted
+            # until release_slot drops the lease. (Counts the hit/miss.)
+            hit_ids, hit_rows = self.prefix_cache.acquire(
+                prompt, keys=chain
+            )
+        elif L <= chunk:
             # A one-chunk prompt can't stall anyone longer than a chunk
             # anyway, and the bucketed prefill_into_slot path moves only
             # a page-aligned prompt prefix where a chunk call round-trips
             # the whole lane — cheaper AND the stall bound still holds.
+            # (Prefix HITS always take the chunked path: the splice +
+            # suffix-only prefill only exists here.)
             return None
-        n = -(-L // chunk)
-        ids = np.zeros((1, n * chunk), np.int32)
+        n = -(-(L - hit_rows) // chunk)
+        ids = np.zeros((1, hit_rows + n * chunk), np.int32)
         ids[0, :L] = prompt
+        if hit_ids:
+            self._leases[slot] = list(hit_ids)
+            self._gtable[slot, :len(hit_ids)] = np.asarray(
+                hit_ids, np.int32
+            )
+            self._refresh_table()
         # Interleaved decode steps still write one (garbage) row at
         # _pos for every lane, active or not; park the mid-prefill
         # lane's write row at the slot's LAST row — admission bounds
         # prompts to token_capacity - 1, so no chunk writes it, and a
         # lane that eventually decodes there overwrites it before its
-        # mask first admits it.
+        # mask first admits it. (The last row is always a PRIVATE page:
+        # splices cover at most (L-1)//ps full pages.)
         self._pos[slot] = self.slot_tokens - 1
         self._active[slot] = False
-        self.pool.lengths[slot] = 0
-        self._table = jnp.asarray(self.pool.page_table_array())
+        self.pool.lengths[slot] = hit_rows
+        if self.prefix_cache is None:
+            self._refresh_table()
         return {
             "slot": slot, "ids": ids, "length": L, "chunk": chunk,
             "next": 0, "n_chunks": n, "sample_key": sample_key,
             "seed": seed, "max_new": max_new,
+            "prompt": prompt, "tenant": tenant,
+            "start_rows": hit_rows, "p0": len(hit_ids),
         }
 
     def advance_prefill(
@@ -1484,32 +1646,216 @@ class StepwiseDecoder:
     ) -> Optional[Dict[str, Any]]:
         """Run ONE prefill chunk (one jit call). Returns None while
         chunks remain; the final chunk samples token #1, activates the
-        lane, and returns prefill_into_slot's info dict."""
+        lane, and returns prefill_into_slot's info dict (plus a
+        `prefix` block when the cache is on: hit/harvest accounting for
+        the scheduler's counters and prefix_hit events).
+
+        Chunks start at `start_rows` (the spliced prefix extent, 0 when
+        cold) — the suffix-only prefill that turns a prefix hit into
+        skipped FLOPs."""
         c = st["next"]
         chunk = st["chunk"]
         slot = st["slot"]
-        fn = self._get_chunk_prefill()
-        logits, caches = fn(
-            self.params,
-            self.pool.caches,
-            jnp.asarray(st["ids"][:, c * chunk:(c + 1) * chunk]),
-            jnp.asarray(slot, jnp.int32),
-            jnp.asarray(c * chunk, jnp.int32),
-            jnp.asarray(st["length"], jnp.int32),
-        )
+        base = int(st.get("start_rows", 0))
+        start = base + c * chunk
+        if self.prefix_cache is not None:
+            fn = self._get_chunk_prefill_cached()
+            logits, caches = fn(
+                self.params,
+                self.pool.caches,
+                jnp.asarray(st["ids"][:, start:start + chunk]),
+                jnp.asarray(slot, jnp.int32),
+                jnp.asarray(self._gtable[slot]),
+                jnp.asarray(int(st.get("p0", 0)), jnp.int32),
+                jnp.asarray(start, jnp.int32),
+                jnp.asarray(st["length"], jnp.int32),
+            )
+        else:
+            fn = self._get_chunk_prefill()
+            logits, caches = fn(
+                self.params,
+                self.pool.caches,
+                jnp.asarray(st["ids"][:, start:start + chunk]),
+                jnp.asarray(slot, jnp.int32),
+                jnp.asarray(start, jnp.int32),
+                jnp.asarray(st["length"], jnp.int32),
+            )
         self.pool.caches = caches
         st["next"] = c + 1
         if st["next"] < st["n_chunks"]:
             # Residency telemetry tracks rows as they land; the lane
             # itself stays inactive until the final chunk.
             self.pool.lengths[slot] = min(
-                (c + 1) * chunk, st["length"]
+                base + (c + 1) * chunk, st["length"]
             )
             return None
-        return self._finish_prefill(
+        info = self._finish_prefill(
             slot, logits, st["length"], st["max_new"],
             st["sample_key"], st["seed"],
         )
+        if self.prefix_cache is not None:
+            harvested = self._harvest(slot, st)
+            info["prefix"] = {
+                "hit_pages": int(st.get("p0", 0)),
+                "tokens_saved": base,
+                "pages_harvested": harvested,
+                "tenant": st.get("tenant", "anon"),
+            }
+        return info
+
+    def _harvest(self, slot: int, st: Dict[str, Any]) -> int:
+        """Register this prompt's freshly-computed full pages in the
+        prefix cache and copy their K/V from the lane's slot into the
+        arena (the one-time cost future admissions amortize away).
+        Returns the number of pages harvested."""
+        assignments = self.prefix_cache.insert(
+            st["prompt"], from_page=int(st.get("p0", 0)),
+            tenant=st.get("tenant", "anon"),
+        )
+        if not assignments:
+            return 0
+        P = self.pool.pages
+        src = [slot * P + j for j, _ in assignments]
+        dst = [pid for _, pid in assignments]
+        K = 1
+        while K < len(src):
+            K *= 2
+        # Pad with self-copies (page 0 -> page 0): bit-identical writes,
+        # so the pow2 executable ladder stays O(log pages).
+        src += [0] * (K - len(src))
+        dst += [0] * (K - len(dst))
+        try:
+            self.pool.caches = self._get_copy_pages(K)(
+                self.pool.caches,
+                jnp.asarray(src, jnp.int32),
+                jnp.asarray(dst, jnp.int32),
+            )
+        except Exception:
+            # The index must never point at arena pages that were not
+            # actually written — a later hit would splice uninitialized
+            # K/V. Unwind and keep serving: harvest is an optimization,
+            # the lane's own prefill already succeeded.
+            logger.exception(
+                "prefix-cache harvest copy failed; unwinding %d page(s)",
+                len(assignments),
+            )
+            self.prefix_cache.forget([pid for _, pid in assignments])
+            return 0
+        return len(assignments)
+
+    def _get_copy_pages(self, K: int):
+        """Jitted bulk page copy: K (src, dst) GLOBAL page id pairs moved
+        inside the paged pool in one call (harvest: lane pages -> arena).
+        One executable per pow2 K."""
+        key = ("copy_pages", K)
+        if key not in self._fns:
+            P = self.pool.pages
+
+            def copy(caches, src, dst):
+                def body(i, caches):
+                    s, d = src[i], dst[i]
+
+                    def cp(leaf):
+                        nd = leaf.ndim
+                        sizes = list(leaf.shape)
+                        sizes[nd - 5] = 1
+                        sizes[nd - 4] = 1
+                        starts = [jnp.asarray(0, jnp.int32)] * nd
+                        starts[nd - 5] = s // P
+                        starts[nd - 4] = s % P
+                        page = jax.lax.dynamic_slice(
+                            leaf, tuple(starts), tuple(sizes)
+                        )
+                        starts[nd - 5] = d // P
+                        starts[nd - 4] = d % P
+                        return jax.lax.dynamic_update_slice(
+                            leaf, page, tuple(starts)
+                        )
+
+                    return jax.tree.map(cp, caches)
+
+                return jax.lax.fori_loop(0, K, body, caches)
+
+            # Same no-donation rationale as the decode step: the pool
+            # must survive a failed call.
+            self._fns[key] = jax.jit(copy)
+        return self._fns[key]
+
+    def _get_chunk_prefill_cached(self):
+        """Prefix-cache-aware chunk prefill: the lane's LOGICAL cache
+        view is gathered through its global page table (spliced arena
+        pages read in place), the chunk runs the identical per-lane
+        multi-row path the legacy executable runs, and the updated view
+        is blended back so only PRIVATE pages (>= p0) land in the lane's
+        own storage — shared prefix bytes are never copied into the
+        slot. ONE executable serves cold (identity table, p0 = 0) and
+        hit admissions alike."""
+        key = "chunk_prefill_cached"
+        if key not in self._fns:
+            engine = self.engine
+            chunk = self.prefill_chunk
+            hint = engine._lane_hint()
+            P = self.pool.pages
+            ps = self.pool.page_size
+
+            def chunk_fn(params, pool_caches, ids, slot, table_row, p0,
+                         start, length):
+                def view_of(leaf):
+                    nd = leaf.ndim
+                    lead = leaf.shape[:nd - 5]
+                    T_ = leaf.shape[nd - 5]
+                    flat = leaf.reshape(
+                        lead + (T_ * P,) + leaf.shape[nd - 3:]
+                    )
+                    view = jnp.take(flat, table_row, axis=nd - 5)
+                    return view.reshape(
+                        lead + (1, P * ps) + leaf.shape[nd - 2:]
+                    )
+
+                lane = jax.tree.map(view_of, pool_caches)
+                pos = start + jnp.arange(chunk)
+                positions = jnp.where(pos < length, pos, -1)[None, :]
+                logits, lane, _ = engine.model.apply(
+                    {"params": params},
+                    ids,
+                    positions=positions,
+                    kv_caches=lane,
+                    cache_index=jnp.reshape(start, (1,)),
+                    deterministic=True,
+                    lane_meta=hint,
+                )
+                last_idx = jnp.clip(length - 1 - start, 0, chunk - 1)
+                last = jnp.take_along_axis(
+                    logits, last_idx[None, None, None], axis=1
+                )[:, 0, :]
+                # Private pages only: the where keeps shared (< p0)
+                # pages' slots holding whatever the lane already had, so
+                # cached bytes never duplicate into lane storage and the
+                # arena pages stay the single physical copy.
+                keep = (jnp.arange(P) >= p0).reshape(1, P, 1, 1, 1)
+
+                def put(p, new_flat):
+                    nd = p.ndim
+                    lead = p.shape[:nd - 5]
+                    paged = new_flat.reshape(
+                        lead + (1, P) + p.shape[nd - 3:]
+                    )
+                    own = jax.lax.dynamic_slice_in_dim(
+                        p, slot, 1, axis=nd - 5
+                    )
+                    merged = jnp.where(keep, paged, own)
+                    starts = [0] * nd
+                    starts[nd - 5] = slot
+                    return jax.lax.dynamic_update_slice(
+                        p, merged, tuple(starts)
+                    )
+
+                return last, jax.tree.map(put, pool_caches, lane)
+
+            # Same no-donation rationale as the decode step: the pool
+            # must survive a failed chunk call.
+            self._fns[key] = jax.jit(chunk_fn)
+        return self._fns[key]
 
     def step_fn_and_args(
         self, sample_key: Optional[Tuple] = None

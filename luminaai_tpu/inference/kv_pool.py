@@ -144,12 +144,20 @@ class PagedKVPool:
     def free(self, slot: int) -> None:
         """Return a slot to the free-list. Stale rows are NOT zeroed —
         every consumer masks by length, and the next prefill overwrites
-        the rows it needs."""
+        the rows it needs. The page-table row IS reset to identity here
+        (not just at the next alloc): with page sharing a freed slot's
+        stale entry aliasing a since-evicted cached page is a
+        silent-corruption class — a decode step between free and realloc
+        still gathers through every lane's table row (masked lanes'
+        output is discarded, but the gather indices must stay honest),
+        so the tombstone cannot wait for alloc (contract-tested across
+        the free → cache-evict → realloc ordering)."""
         with self._lock:
             if slot not in self._allocated:
                 raise ValueError(f"slot {slot} is not allocated")
             self._allocated.remove(slot)
             self.lengths[slot] = 0
+            self.page_tables[slot] = np.arange(self.pages, dtype=np.int32)
             self._free.append(slot)
 
     def allocated_slots(self) -> List[int]:

@@ -629,7 +629,13 @@ class GQAttention(nn.Module):
                 kind="decode" if Sq == 1 else "prefill",
                 page_size=implied_page_size(k.shape[1]),
             )
-        if meta.extent is not None and meta.extent < k.shape[1]:
+        if getattr(meta, "global_pages", False):
+            # Prefix-cache aliasing: physical pages may live in ANY slot
+            # (including the cache arena), so the k/v rows cannot be
+            # pre-sliced — the op slices the page TABLE to the extent
+            # instead, and its gather output is still O(extent) rows.
+            pass
+        elif meta.extent is not None and meta.extent < k.shape[1]:
             # Post-write resident-extent slice: decode reads O(tokens
             # resident), not O(pool capacity). XLA prices a slice at its
             # output bytes, so the compiled decode step's bytes-accessed

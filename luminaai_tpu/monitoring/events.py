@@ -224,12 +224,17 @@ def filter_events(
     type: Optional[str] = None,
     grep: Optional[str] = None,
     tail: Optional[int] = None,
+    request: Optional[str] = None,
 ) -> List[Dict[str, Any]]:
-    """Shared query semantics for the CLI and tests: type match, regex
-    over the serialized record, then last-N."""
+    """Shared query semantics for the CLI and tests: type match, one
+    request's lifecycle (`lumina events --request <id>`: admission →
+    prefix_hit → chunks → completion), regex over the serialized
+    record, then last-N."""
     out = list(events)
     if type:
         out = [e for e in out if e.get("type") == type]
+    if request:
+        out = [e for e in out if e.get("request_id") == request]
     if grep:
         rx = re.compile(grep)
         out = [

@@ -98,7 +98,20 @@ class LaneMeta:
     # ladder (StepwiseDecoder does) so the executable count stays
     # O(log pages), mirroring the prompt-bucket discipline. None = full
     # extent. Every lane's lengths must satisfy lengths <= extent.
+    # (Under global_pages the extent bounds the LOGICAL page count — it
+    # slices the page TABLE, not the K/V rows, since physical pages may
+    # live in any slot.)
     extent: Optional[int] = struct.field(pytree_node=False, default=None)
+    # GLOBAL page addressing (prefix cache): table entries are ids into
+    # the flattened (slot, page) space of the WHOLE pool — global id
+    # t * P_slot + p addresses physical page p of slot t — so a lane can
+    # alias pages physically resident in ANOTHER slot (the copy-on-write
+    # prefix-sharing substrate). k/v then arrive as the full pool
+    # [T, C, Hkv, D] with T >= B; q stays [B, ...]. Lanes' private pages
+    # are their own identity ids (b * P_slot + j); shared read-only
+    # prefix pages point into the cache arena. Implies a real gather
+    # (identity_pages is ignored).
+    global_pages: bool = struct.field(pytree_node=False, default=False)
 
 
 def ragged_eligible(page_size: int, head_dim: int, s_q: int) -> bool:
@@ -143,11 +156,36 @@ def ragged_paged_attention_xla(
     The mask formula is exactly the dense per-lane decode mask
     (models/layers.py) restricted by residency — greedy streams through
     this path are token-identical to the dense backend by construction.
+
+    Under meta.global_pages, k/v are the FULL pool [T, C, Hkv, D]
+    (T >= B lanes + prefix-cache arena slots) and table entries are
+    global (slot, page) ids — the gather pulls each lane's logical pages
+    from wherever they physically live, which is how a shared cached
+    prefix page serves many lanes without its bytes ever being copied
+    into their slots. meta.extent slices the TABLE's logical pages, so
+    compute/bytes still scale with tokens resident.
     """
     B, Sq, n_q, d = q.shape
     C, n_kv = k.shape[1], k.shape[2]
     ps = meta.page_size
-    if meta.page_table is not None and not meta.identity_pages:
+    if meta.global_pages:
+        # Global gather: [T, C] pool rows -> [T*P_all, ps] physical
+        # pages -> [B, P_l, ps] logical pages per lane via the global
+        # page table (extent-sliced: logical pages past the resident
+        # bound are never touched).
+        T, P_all = k.shape[0], C // ps
+        table = meta.page_table.astype(jnp.int32)
+        if meta.extent is not None and meta.extent < C:
+            table = table[:, : meta.extent // ps]
+        P_l = table.shape[1]
+        k = jnp.take(
+            k.reshape(T * P_all, ps, n_kv, d), table, axis=0
+        ).reshape(B, P_l * ps, n_kv, d)
+        v = jnp.take(
+            v.reshape(T * P_all, ps, n_kv, d), table, axis=0
+        ).reshape(B, P_l * ps, n_kv, d)
+        C = P_l * ps
+    elif meta.page_table is not None and not meta.identity_pages:
         # Physical gather through the page table: [B, P] page ids pick
         # pages off the lane's own page axis. Identity tables skip this
         # (the values would be bit-identical; the copy would not be free).
@@ -260,10 +298,15 @@ def _decode_kernel(
         o_ref[0, 0, :, :] = (acc_scr[:] / safe_l[:, :1]).astype(o_ref.dtype)
 
 
-def _page_index_map(group, page_size, n_pages, window):
+def _page_index_map(group, page_size, n_pages, window, pool_pages=None):
     """K/V BlockSpec index map: chase the page table for live pages,
     clamp skipped grid steps onto the lane's last live page (same block
-    index as a neighbouring step ⇒ Pallas skips the DMA entirely)."""
+    index as a neighbouring step ⇒ Pallas skips the DMA entirely).
+
+    pool_pages: pages-per-slot of the pool when table entries are GLOBAL
+    (slot, page) ids (prefix-cache aliasing) — the map then decomposes
+    the id back into (slot, page) block coordinates, so a lane's logical
+    page can be fetched from another slot's storage."""
 
     def index(b, h, j, lengths, table):
         length = lengths[b]
@@ -273,6 +316,8 @@ def _page_index_map(group, page_size, n_pages, window):
             first = jnp.maximum(length - window, 0) // page_size
         jv = jnp.clip(j, first, last)
         phys = table[b, jnp.minimum(jv, n_pages - 1)]
+        if pool_pages is not None:
+            return (phys // pool_pages, h // group, phys % pool_pages, 0, 0)
         return (b, h // group, phys, 0, 0)
 
     return index
@@ -299,28 +344,44 @@ def ragged_paged_attention(
     group = Hq // Hkv
 
     lengths = meta.lengths.astype(jnp.int32)
-    if meta.page_table is not None:
+    pool_pages = None
+    if meta.global_pages:
+        # Global (slot, page) addressing: k/v are the full pool
+        # [T, C, ...]; the grid's page axis runs over each lane's
+        # LOGICAL pages (extent-sliced), and the index map decomposes
+        # global table ids into pool block coordinates.
+        pool_pages = P
+        table = meta.page_table.astype(jnp.int32)
+        if meta.extent is not None and meta.extent < C:
+            table = table[:, : meta.extent // ps]
+        P_grid = table.shape[1]
+    elif meta.page_table is not None:
         table = meta.page_table.astype(jnp.int32)[:, :P]
+        P_grid = P
     else:
         table = jnp.tile(jnp.arange(P, dtype=jnp.int32)[None], (B, 1))
+        P_grid = P
 
     qt = q.transpose(0, 2, 1, 3)  # [B, Hq, 1, D]
-    kt = k.reshape(B, P, ps, Hkv, D).transpose(0, 3, 1, 2, 4)
-    vt = v.reshape(B, P, ps, Hkv, D).transpose(0, 3, 1, 2, 4)
+    T = k.shape[0]
+    kt = k.reshape(T, P, ps, Hkv, D).transpose(0, 3, 1, 2, 4)
+    vt = v.reshape(T, P, ps, Hkv, D).transpose(0, 3, 1, 2, 4)
 
     window = int(meta.window or 0)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(B, Hq, P),
+        grid=(B, Hq, P_grid),
         in_specs=[
             pl.BlockSpec(
                 (1, 1, 1, D), lambda b, h, j, lengths, table: (b, h, 0, 0)
             ),
             pl.BlockSpec(
-                (1, 1, 1, ps, D), _page_index_map(group, ps, P, window)
+                (1, 1, 1, ps, D),
+                _page_index_map(group, ps, P_grid, window, pool_pages),
             ),
             pl.BlockSpec(
-                (1, 1, 1, ps, D), _page_index_map(group, ps, P, window)
+                (1, 1, 1, ps, D),
+                _page_index_map(group, ps, P_grid, window, pool_pages),
             ),
         ],
         out_specs=pl.BlockSpec(

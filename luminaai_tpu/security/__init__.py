@@ -14,6 +14,8 @@ from luminaai_tpu.security.input_validator import (
 from luminaai_tpu.security.rate_limiter import (
     RateLimiter,
     SecureChatSession,
+    TokenBucket,
+    TokenBucketLimiter,
 )
 
 __all__ = [
@@ -25,4 +27,6 @@ __all__ = [
     "ValidationResult",
     "RateLimiter",
     "SecureChatSession",
+    "TokenBucket",
+    "TokenBucketLimiter",
 ]

@@ -10,6 +10,7 @@ traffic, and audit-logs the session).
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -81,6 +82,115 @@ class RateLimiter:
             if not self._window(key, window, now):
                 del self._events[key]
         return len(self._events)
+
+
+class TokenBucket:
+    """One tenant's token bucket: capacity `burst`, refilled continuously
+    at `rate_per_s`. The clock is INJECTED (defaults to time.monotonic)
+    so refill timing is testable without sleeps and immune to wall-clock
+    jumps."""
+
+    def __init__(
+        self,
+        rate_per_s: float,
+        burst: float,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.rate = float(rate_per_s)
+        self.burst = float(burst)
+        self.clock = clock
+        self.tokens = float(burst)
+        self._last = clock()
+
+    def _refill(self) -> None:
+        now = self.clock()
+        elapsed = max(0.0, now - self._last)
+        self._last = now
+        self.tokens = min(self.burst, self.tokens + elapsed * self.rate)
+
+    def allow(self, cost: float = 1.0) -> bool:
+        self._refill()
+        if self.tokens >= cost:
+            self.tokens -= cost
+            return True
+        return False
+
+    def retry_after(self, cost: float = 1.0) -> float:
+        """Seconds until `cost` tokens will be available (0 if now)."""
+        self._refill()
+        missing = cost - self.tokens
+        if missing <= 0:
+            return 0.0
+        return missing / max(self.rate, 1e-9)
+
+
+class TokenBucketLimiter:
+    """Per-tenant token-bucket admission for the serving gate
+    (ChatServer._gate): one bucket per tenant LABEL. Callers must pass
+    HASHED tenants (security.auth.tenant_hash) — bucket keys are
+    introspectable state and raw identities must never appear in them
+    (tier-1 contract-tested). Thread-safe: the server gates under its
+    state lock, but /stats-style readers may race emitters."""
+
+    def __init__(
+        self,
+        rate_per_s: float = 10.0,
+        burst: int = 20,
+        clock: Callable[[], float] = time.monotonic,
+        max_buckets: int = 4096,
+    ):
+        self.rate = float(rate_per_s)
+        self.burst = float(burst)
+        self.clock = clock
+        # Bounded state, same discipline LX009 enforces on tenant metric
+        # labels: rotating identities must not grow server memory
+        # without bound. At the cap, idle (fully-refilled) buckets are
+        # swept first — dropping one is semantically a no-op, a fresh
+        # bucket starts full anyway — then oldest-touched.
+        self.max_buckets = max(1, int(max_buckets))
+        self._buckets: Dict[str, TokenBucket] = {}
+        self._lock = threading.Lock()
+
+    def _bucket(self, tenant: str) -> TokenBucket:
+        b = self._buckets.get(tenant)
+        if b is None:
+            if len(self._buckets) >= self.max_buckets:
+                self._prune()
+            b = self._buckets[tenant] = TokenBucket(
+                self.rate, self.burst, clock=self.clock
+            )
+        return b
+
+    def _prune(self) -> None:
+        idle = [
+            k for k, b in self._buckets.items()
+            if b.tokens + (self.clock() - b._last) * b.rate >= b.burst
+        ]
+        for k in idle:
+            del self._buckets[k]
+        while len(self._buckets) >= self.max_buckets:
+            oldest = min(self._buckets, key=lambda k: self._buckets[k]._last)
+            del self._buckets[oldest]
+
+    def allow(self, tenant: str, cost: float = 1.0) -> bool:
+        with self._lock:
+            return self._bucket(tenant).allow(cost)
+
+    def retry_after(self, tenant: str, cost: float = 1.0) -> float:
+        with self._lock:
+            return self._bucket(tenant).retry_after(cost)
+
+    def remaining(self, tenant: str) -> float:
+        """Pure read: never allocates a bucket (an introspection call
+        for an unseen tenant must not trigger the cap's prune and evict
+        a live bucket). Unseen tenants report a full bucket — that is
+        exactly what allow() would start them with."""
+        with self._lock:
+            b = self._buckets.get(tenant)
+            if b is None:
+                return self.burst
+            b._refill()
+            return b.tokens
 
 
 class SecureChatSession:

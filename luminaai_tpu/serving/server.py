@@ -95,7 +95,9 @@ class MicroBatcher:
     requests are requeued for the next cycle, so nothing starves.
     """
 
-    def __init__(self, engine, max_batch: int = 8, window_ms: float = 15.0):
+    def __init__(self, engine, max_batch: int = 8, window_ms: float = 15.0,
+                 recorder: Optional[FlightRecorder] = None,
+                 telemetry: bool = True):
         self.engine = engine
         self.max_batch = max(1, int(max_batch))
         self.window = max(0.0, float(window_ms)) / 1000.0
@@ -103,6 +105,14 @@ class MicroBatcher:
         self.batches = 0
         self.max_batch_seen = 0
         self._busy = False  # a batch is being generated right now
+        # Identity-aware accounting parity with the continuous path:
+        # submit() strips the request_id/tenant riders the server
+        # attaches (they must never reach generate_batch) and emits the
+        # same admitted/completed lifecycle events, so /metrics
+        # per-tenant series and the flight trail stay honest when the
+        # fallback path (--no-continuous) is serving.
+        self.telemetry = bool(telemetry)
+        self.recorder = recorder if recorder is not None else get_recorder()
         self._worker = threading.Thread(target=self._loop, daemon=True)
         self._worker.start()
 
@@ -116,6 +126,20 @@ class MicroBatcher:
     def submit(
         self, prompt_tokens: List[int], gen_kwargs: Dict[str, Any]
     ) -> Tuple[List[int], Dict[str, Any]]:
+        # Identity riders are host metadata, never engine kwargs (the
+        # same strip-before-compile-key contract the continuous
+        # scheduler's _make_request applies).
+        gen_kwargs = dict(gen_kwargs)
+        request_id = gen_kwargs.pop("request_id", None)
+        tenant = gen_kwargs.pop("tenant", None) or ANON_TENANT
+        gen_kwargs.pop("timeout_s", None)  # run-to-completion path
+        t0 = time.time()
+        if self.telemetry and request_id is not None:
+            self.recorder.emit(
+                "request_admitted", request_id=request_id, tenant=tenant,
+                scheduler="micro_batch",
+                prompt_tokens=len(prompt_tokens),
+            )
         ev = threading.Event()
         slot: Dict[str, Any] = {}
         resolve = getattr(self.engine, "_resolve_gen_key", None)
@@ -136,7 +160,20 @@ class MicroBatcher:
         ev.wait()
         if "error" in slot:
             raise slot["error"]
-        return slot["result"]
+        tokens, stats = slot["result"]
+        if request_id is not None:
+            # The reply payload correlates on these like the continuous
+            # path's stats do.
+            stats = {**stats, "request_id": request_id, "tenant": tenant}
+            if self.telemetry:
+                self.recorder.emit(
+                    "request_completed", request_id=request_id,
+                    tenant=tenant, scheduler="micro_batch",
+                    tokens=len(tokens),
+                    seconds=round(time.time() - t0, 3),
+                    stopped=stats.get("stopped"),
+                )
+        return tokens, stats
 
     def _loop(self) -> None:
         while True:
@@ -251,6 +288,9 @@ class ContinuousScheduler:
         max_tenants: int = 64,
         tick_every: int = 16,
         prefill_chunk_tokens: Optional[int] = None,
+        prefix_cache_pages: Optional[int] = None,
+        prefix_cache_tenant_quota: Optional[int] = None,
+        tenant_weights: Optional[Dict[str, int]] = None,
     ):
         self.engine = engine
         # Default per-request deadline; a request's own timeout_s can only
@@ -262,19 +302,50 @@ class ContinuousScheduler:
                 page_size=page_size,
                 max_slot_tokens=max_slot_tokens,
             )
-            # Duck-typed engines may predate the chunked-prefill kwarg:
-            # inspect the signature instead of catching TypeError, which
-            # would also swallow genuine constructor errors.
+            # Duck-typed engines may predate the chunked-prefill /
+            # prefix-cache kwargs: inspect the signature instead of
+            # catching TypeError, which would also swallow genuine
+            # constructor errors.
             try:
-                accepts_chunk = "prefill_chunk_tokens" in (
+                accepted = set(
                     inspect.signature(engine.make_stepwise).parameters
                 )
             except (TypeError, ValueError):
-                accepts_chunk = False
-            if accepts_chunk:
+                accepted = set()
+            if "prefill_chunk_tokens" in accepted:
                 kw["prefill_chunk_tokens"] = prefill_chunk_tokens
+            if "prefix_cache_pages" in accepted:
+                kw["prefix_cache_pages"] = prefix_cache_pages
+                kw["prefix_cache_tenant_quota"] = prefix_cache_tenant_quota
             decoder = engine.make_stepwise(**kw)
         self.decoder = decoder
+        # Whether the decoder's chunked admission accepts the tenant
+        # rider (the prefix cache attributes pages per tenant).
+        try:
+            self._prefill_takes_tenant = "tenant" in inspect.signature(
+                decoder.start_prefill
+            ).parameters
+        except (AttributeError, TypeError, ValueError):
+            self._prefill_takes_tenant = False
+        # Fair-share admission (tenant QoS): queued requests park in
+        # per-tenant FIFOs and are dequeued WEIGHTED ROUND-ROBIN across
+        # tenants, so one hot tenant flooding the intake cannot starve
+        # the rest. tenant_weights maps tenant LABEL (hashed identity) ->
+        # dequeues per round (priority lanes: weight n tenants drain up
+        # to n requests per rotation); default weight 1.
+        self.tenant_weights: Dict[str, int] = {
+            str(k): max(1, int(v))
+            for k, v in (tenant_weights or {}).items()
+        }
+        self._tq: Dict[str, Any] = {}  # tenant -> deque of requests
+        self._rr: List[str] = []  # round-robin rotation order
+        self._credits: Dict[str, int] = {}  # WRR dequeues used this turn
+        # The worker owns _tq's CONTENTS, but queue_depth() iterates it
+        # from request threads (_shed) and /metrics scrapes — guard the
+        # dict's shape so a new tenant's insert can never crash a
+        # concurrent depth read with "dict changed size during
+        # iteration".
+        self._tq_lock = threading.Lock()
         # Admissions mid-prefill: slot -> (request, decoder chunk state,
         # admission timestamp). The worker advances ONE chunk per loop
         # tick, interleaved with decode steps, so a long prompt cannot
@@ -412,9 +483,115 @@ class ContinuousScheduler:
                 "Rows lost to page rounding (allocated but not live)",
                 "fragmentation_rows",
             )
+        # Prefix cache (inference/prefix_cache.py): hit/miss/savings
+        # counters observed at admission, plus pull-time occupancy /
+        # refcount / eviction gauges straight off the cache's stats.
+        self._m_prefix_hits = r.counter(
+            "serve_prefix_cache_hits_total",
+            "Admissions that spliced at least one cached prefix page",
+        )
+        self._m_prefix_misses = r.counter(
+            "serve_prefix_cache_misses_total",
+            "Chunked admissions that found no cached prefix",
+        )
+        self._m_prefix_saved = r.counter(
+            "serve_prefill_tokens_saved_total",
+            "Prompt tokens whose prefill was skipped via cached prefix "
+            "pages",
+        )
+        # Tenant-keyed cache residency rides under the same label budget
+        # as every other tenant series (`lumina analyze` LX009 enforces
+        # the max_label_values declaration).
+        self._m_tenant_prefix_pages = r.gauge(
+            "tenant_prefix_cache_pages",
+            "Arena pages currently cached per owning tenant",
+            labelnames=("tenant",),
+            max_label_values=self.max_tenants,
+        )
+        cache = getattr(self.decoder, "prefix_cache", None)
+        if cache is not None:
+            # prefix_evict flight events ride the scheduler's recorder,
+            # honoring the same telemetry off switch.
+            cache.recorder = self.recorder if self.telemetry else None
+
+            def cache_gauge(name, help_text, key):
+                r.gauge(name, help_text).set_function(
+                    weak_callback(cache, lambda c: c.stats().get(key, 0))
+                )
+
+            cache_gauge("prefix_cache_pages_cached",
+                        "Arena pages holding cached prefix KV",
+                        "pages_cached")
+            cache_gauge("prefix_cache_pages_free",
+                        "Arena pages free for harvest", "pages_free")
+            cache_gauge("prefix_cache_page_refs",
+                        "Live lane references onto cached pages "
+                        "(sharing fan-out)", "page_refs")
+            cache_gauge("prefix_cache_evictions",
+                        "Cached pages LRU-evicted since start",
+                        "evictions")
+            cache_gauge("prefix_cache_pages_budget",
+                        "Configured arena page budget "
+                        "(--prefix-cache-pages)", "capacity_pages")
 
     def queue_depth(self) -> int:
-        return self.q.qsize() + len(self._pending)
+        with self._tq_lock:
+            parked = sum(len(d) for d in self._tq.values())
+        return self.q.qsize() + len(self._pending) + parked
+
+    # -- fair-share tenant queues (worker thread only) ---------------------
+    def _enqueue_tenant(self, req: "_ContinuousRequest") -> None:
+        from collections import deque
+
+        t = req.tenant or ANON_TENANT
+        dq = self._tq.get(t)
+        if dq is None:
+            with self._tq_lock:
+                dq = self._tq[t] = deque()
+            self._rr.append(t)
+        dq.append(req)
+
+    def _drain_intake(self) -> None:
+        """Move everything waiting on the intake queue into the
+        per-tenant FIFOs (worker thread only — submit() threads touch
+        only self.q)."""
+        while True:
+            try:
+                self._enqueue_tenant(self.q.get_nowait())
+            except queue.Empty:
+                return
+
+    def _next_queued(self) -> Optional["_ContinuousRequest"]:
+        """Weighted round-robin dequeue across tenant queues: each
+        rotation visits tenants in arrival order, draining up to
+        `tenant_weights[t]` (default 1) requests before moving on —
+        a tenant with 50 queued requests and a tenant with 1 alternate
+        instead of the flood going first (contract-tested: the starved
+        tenant's queue keeps draining under a hot-tenant flood)."""
+        if not self._rr:
+            return None
+        # One WRR credit per call: rotate to the next tenant with work,
+        # respecting per-tenant weight via a running credit counter.
+        for _ in range(len(self._rr)):
+            t = self._rr[0]
+            dq = self._tq.get(t)
+            if not dq:
+                # Empty queue: drop the tenant from the rotation (it
+                # re-registers on its next submit).
+                self._rr.pop(0)
+                with self._tq_lock:
+                    self._tq.pop(t, None)
+                self._credits.pop(t, None)
+                continue
+            used = self._credits.get(t, 0)
+            if used + 1 >= self.tenant_weights.get(t, 1):
+                # Weight exhausted after this dequeue: rotate.
+                self._credits[t] = 0
+                self._rr.append(self._rr.pop(0))
+            else:
+                self._credits[t] = used + 1
+            return dq.popleft()
+        return None
 
     def idle(self) -> bool:
         """No request anywhere between submit and its terminal
@@ -486,6 +663,9 @@ class ContinuousScheduler:
         pool = getattr(self.decoder, "pool", None)
         if pool is not None and hasattr(pool, "stats"):
             out["kv_pool"] = pool.stats()
+        cache = getattr(self.decoder, "prefix_cache", None)
+        if cache is not None:
+            out["prefix_cache"] = cache.stats()
         return out
 
     # -- internals ---------------------------------------------------------
@@ -664,6 +844,13 @@ class ContinuousScheduler:
                     max_new_tokens=req.max_new,
                     sample_key=req.sample_key,
                     seed=req.seed,
+                    # Tenant rider: the prefix cache attributes harvested
+                    # pages per tenant (quota enforcement).
+                    **(
+                        {"tenant": req.tenant}
+                        if self._prefill_takes_tenant
+                        else {}
+                    ),
                 )
             except Exception as e:
                 logger.exception("start-prefill failed")
@@ -719,6 +906,28 @@ class ContinuousScheduler:
         )
         self._event("request_first_token", req, slot=slot,
                     ttft_s=round(ttft, 4))
+        prefix = info.get("prefix") if isinstance(info, dict) else None
+        if prefix is not None:
+            if self.telemetry:
+                if prefix.get("hit_pages"):
+                    self._m_prefix_hits.inc()
+                else:
+                    self._m_prefix_misses.inc()
+                saved = int(prefix.get("tokens_saved", 0))
+                if saved:
+                    self._m_prefix_saved.inc(saved)
+                cache = getattr(self.decoder, "prefix_cache", None)
+                if cache is not None:
+                    t = prefix.get("tenant") or req.tenant
+                    self._m_tenant_prefix_pages.labels(tenant=t).set(
+                        cache.tenant_pages(t)
+                    )
+            if prefix.get("hit_pages"):
+                self._event(
+                    "prefix_hit", req, slot=slot,
+                    pages=int(prefix["hit_pages"]),
+                    tokens_saved=int(prefix.get("tokens_saved", 0)),
+                )
         req.slot = slot
         req.prompt_tokens = int(info.get("prompt_tokens", 0))
         req.admitted_step = int(getattr(self.decoder, "steps", 0))
@@ -736,13 +945,16 @@ class ContinuousScheduler:
         self.max_batch_seen = max(self.max_batch_seen, len(active))
 
     def _admit_queued(self, key, active: dict) -> None:
-        """Admit queued same-key requests into free slots. Once a
-        MISMATCHED-key request is waiting, admission pauses so the active
-        lanes drain and the scheduler can switch keys (no starvation)."""
+        """Admit queued same-key requests into free slots, dequeued
+        FAIR-SHARE (weighted round-robin across tenant queues — one hot
+        tenant's flood cannot starve the rest; docs/serving.md "Prefix
+        cache + tenant QoS"). Once a MISMATCHED-key request is waiting,
+        admission pauses so the active lanes drain and the scheduler can
+        switch keys (no starvation across sampling keys either)."""
+        self._drain_intake()
         while self.decoder.has_free_slot() and not self._pending:
-            try:
-                nxt = self.q.get_nowait()
-            except queue.Empty:
+            nxt = self._next_queued()
+            if nxt is None:
                 break
             if nxt.sample_key == key:
                 self._admit(nxt, active)
@@ -783,7 +995,12 @@ class ContinuousScheduler:
         self._event(
             "prefill_chunk", req, slot=slot,
             chunk=int(st["next"]), chunks=int(st["n_chunks"]),
-            rows=int(min(st["next"] * st["chunk"], st["length"])),
+            # Rows RESIDENT, spliced prefix included — must agree with
+            # the decoder's own residency booking for a prefix hit.
+            rows=int(min(
+                int(st.get("start_rows", 0)) + st["next"] * st["chunk"],
+                st["length"],
+            )),
         )
         if info is None:
             # More chunks pending: back of the round-robin ring.
@@ -794,7 +1011,17 @@ class ContinuousScheduler:
 
     def _loop(self) -> None:
         while True:
-            req = self._pending.pop(0) if self._pending else self.q.get()
+            if self._pending:
+                req = self._pending.pop(0)
+            else:
+                self._drain_intake()
+                req = self._next_queued()
+                if req is None:
+                    # Nothing parked anywhere: block for the next submit,
+                    # then run it through the same fair-share path.
+                    self._enqueue_tenant(self.q.get())
+                    self._drain_intake()
+                    req = self._next_queued()
             self._busy = True
             try:
                 self._run_generation(req)
@@ -815,6 +1042,9 @@ class ContinuousScheduler:
         # Optional admission window: wait briefly for same-key peers so
         # the first step already carries a batch (a latency/throughput
         # knob, NOT required for joining — lanes join at any later step).
+        # Peers are dequeued through the same fair-share WRR path as
+        # steady-state admission, so requests already parked in tenant
+        # queues go first and a burst inside the window cannot jump them.
         deadline = time.time() + self.window
         while (
             self.window > 0
@@ -824,10 +1054,14 @@ class ContinuousScheduler:
             left = deadline - time.time()
             if left <= 0:
                 break
-            try:
-                nxt = self.q.get(timeout=left)
-            except queue.Empty:
-                break
+            self._drain_intake()
+            nxt = self._next_queued()
+            if nxt is None:
+                try:
+                    self._enqueue_tenant(self.q.get(timeout=left))
+                except queue.Empty:
+                    break
+                continue
             if nxt.sample_key == key:
                 self._admit(nxt, active)
             else:
@@ -972,6 +1206,11 @@ class ChatServer:
         max_tenants: int = 64,
         recorder: Optional[FlightRecorder] = None,
         prefill_chunk_tokens: Optional[int] = None,
+        prefix_cache_pages: Optional[int] = None,
+        prefix_cache_tenant_quota: Optional[int] = None,
+        tenant_weights: Optional[Dict[str, int]] = None,
+        tenant_rate_per_s: Optional[float] = None,
+        tenant_burst: Optional[int] = None,
     ):
         self.engine = engine
         self.telemetry = bool(telemetry)
@@ -1008,6 +1247,14 @@ class ChatServer:
             or (continuous == "auto" and hasattr(engine, "make_stepwise"))
         )
         if self.continuous:
+            # Operator-supplied tenant weights are keyed by RAW identity
+            # (or the literal "anon"); hash them here so raw identities
+            # never live in scheduler state — the same tenant_hash the
+            # gate resolves request identities through.
+            weights = {
+                (k if k == ANON_TENANT else tenant_hash(str(k))): v
+                for k, v in (tenant_weights or {}).items()
+            }
             self.batcher = ContinuousScheduler(
                 engine,
                 num_slots=num_slots,
@@ -1021,10 +1268,30 @@ class ChatServer:
                 recorder=self.recorder,
                 max_tenants=self.max_tenants,
                 prefill_chunk_tokens=prefill_chunk_tokens,
+                prefix_cache_pages=prefix_cache_pages,
+                prefix_cache_tenant_quota=prefix_cache_tenant_quota,
+                tenant_weights=weights,
             )
         else:
             self.batcher = MicroBatcher(
-                engine, max_batch=max_batch, window_ms=batch_window_ms
+                engine, max_batch=max_batch, window_ms=batch_window_ms,
+                recorder=self.recorder, telemetry=telemetry,
+            )
+        # Per-tenant token-bucket admission (rate_limiter.py): every
+        # generation request costs one token from its tenant's bucket —
+        # burst-tolerant, steady-state rate-bounded. Applies in _gate
+        # whenever configured (secure or not; unauthenticated traffic
+        # shares the anon tenant's bucket). Keys are ALWAYS hashed
+        # tenants, never raw identities.
+        self.tenant_bucket = None
+        if tenant_rate_per_s:
+            from luminaai_tpu.security.rate_limiter import (
+                TokenBucketLimiter,
+            )
+
+            self.tenant_bucket = TokenBucketLimiter(
+                rate_per_s=float(tenant_rate_per_s),
+                burst=int(tenant_burst or max(1, int(tenant_rate_per_s))),
             )
         r = self.registry
         self._m_http = r.counter(
@@ -1375,19 +1642,43 @@ class ChatServer:
         )
 
     def _gate(self, body: Dict[str, Any], token: Optional[str]):
-        """Secure-mode checks: session token, rate limit, input
-        validation. Returns (error_tuple | None, tenant_label) — the
-        tenant is the hashed authenticated identity, so accounting and
-        events never carry raw usernames."""
+        """Admission checks: session token, per-tenant rate limiting,
+        input validation. Returns (error_tuple | None, tenant_label) —
+        the tenant is the hashed authenticated identity, so accounting,
+        events AND limiter state never carry raw usernames.
+
+        Two limiter layers compose here: the secure-mode sliding-window
+        limiter (legacy request-count policy) and the optional per-tenant
+        TOKEN BUCKET (--tenant-rate/--tenant-burst), which also applies
+        to unauthenticated traffic via the shared anon tenant."""
+        tenant = ANON_TENANT
+        if self.secure:
+            session = self.security.validate_session(token or "")
+            if session is None:
+                return (
+                    (401, {"error": "missing or invalid token"}),
+                    ANON_TENANT,
+                )
+            user = session.get("username", "anonymous")
+            tenant = tenant_hash(user)
+            # Limiter state is keyed by the HASHED tenant — the limiter's
+            # bucket dict is introspectable (and dumpable in bug
+            # reports), so raw identities must never appear in its keys.
+            if not self.limiter.is_allowed(tenant, "chat"):
+                return (429, {"error": "rate limit exceeded"}), tenant
+        if self.tenant_bucket is not None and not self.tenant_bucket.allow(
+            tenant
+        ):
+            retry = self.tenant_bucket.retry_after(tenant)
+            return (
+                429,
+                {
+                    "error": "tenant rate limit exceeded",
+                    "retry_after": max(1, int(retry + 0.999)),
+                },
+            ), tenant
         if not self.secure:
-            return None, ANON_TENANT
-        session = self.security.validate_session(token or "")
-        if session is None:
-            return (401, {"error": "missing or invalid token"}), ANON_TENANT
-        user = session.get("username", "anonymous")
-        tenant = tenant_hash(user)
-        if not self.limiter.is_allowed(user, "chat"):
-            return (429, {"error": "rate limit exceeded"}), tenant
+            return None, tenant
         text = body.get("prompt") or body.get("message") or ""
         if not text and body.get("messages"):
             text = " ".join(
@@ -1476,16 +1767,16 @@ class ChatServer:
         # batched decode (MicroBatcher); sampling overrides go as generate
         # kwargs, so there is no config mutation to serialize.
         timeout_s = self._effective_timeout(body)
-        if self.continuous:
-            # Identity riders (stripped before the compile key) + the
-            # deadline, a continuous-scheduler contract (step-level
-            # eviction); the legacy run-to-completion path gets neither
-            # (its engine kwargs reach generate_batch verbatim).
-            overrides = {
-                **overrides, "request_id": request_id, "tenant": tenant,
-            }
-            if timeout_s:
-                overrides["timeout_s"] = timeout_s
+        # Identity riders ride BOTH schedulers' submit (each strips them
+        # before its compile key / engine kwargs), so per-tenant series
+        # and the flight trail stay honest on the --no-continuous
+        # fallback path too. The deadline is a continuous-scheduler
+        # contract (step-level eviction); MicroBatcher drops it.
+        overrides = {
+            **overrides, "request_id": request_id, "tenant": tenant,
+        }
+        if timeout_s:
+            overrides["timeout_s"] = timeout_s
         try:
             tokens, stats = self.batcher.submit(prompt_ids, overrides)
         except RequestTimeout as e:
@@ -2022,6 +2313,10 @@ def serve(
     flight_dir: Optional[str] = None,
     max_tenants: int = 64,
     prefill_chunk_tokens: Optional[int] = None,
+    prefix_cache_pages: Optional[int] = None,
+    prefix_cache_tenant_quota: Optional[int] = None,
+    tenant_rate_per_s: Optional[float] = None,
+    tenant_burst: Optional[int] = None,
 ):
     """Build an engine from a checkpoint and serve it (CLI `serve`)."""
     from luminaai_tpu.inference.chat import ChatInterface
@@ -2040,6 +2335,10 @@ def serve(
         continuous=continuous, num_slots=num_slots, page_size=page_size,
         admission_window_ms=admission_window_ms,
         prefill_chunk_tokens=prefill_chunk_tokens,
+        prefix_cache_pages=prefix_cache_pages,
+        prefix_cache_tenant_quota=prefix_cache_tenant_quota,
+        tenant_rate_per_s=tenant_rate_per_s,
+        tenant_burst=tenant_burst,
         telemetry=telemetry,
         tracer=tracer,
         request_timeout_s=request_timeout_s,

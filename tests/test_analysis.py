@@ -110,6 +110,13 @@ GOLDEN_FIXTURES = {
         "    except:\n"
         "        return None\n"
     ),
+    "LX009": (
+        "def wire(r):\n"
+        "    return r.counter(\n"
+        "        'tenant_requests_total', 'per-tenant requests',\n"
+        "        labelnames=('tenant',),\n"
+        "    )\n"
+    ),
 }
 
 
@@ -610,3 +617,43 @@ def test_cli_analyze_waived_finding_passes(tmp_path, capsys):
     (tmp_path / "waived.py").write_text(src)
     assert _run_analyze([str(tmp_path)]) == 0
     assert "waived" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# LX009 — tenant-label budget semantics (prefix cache / QoS series)
+# ---------------------------------------------------------------------------
+def test_lx009_budgeted_tenant_families_are_silent():
+    src = (
+        "def wire(r, n):\n"
+        "    tk = dict(labelnames=('tenant',), max_label_values=n)\n"
+        "    r.counter('tenant_requests_total', 'h', **tk)\n"
+        "    return r.gauge('tenant_prefix_cache_pages', 'h',\n"
+        "                   labelnames=('tenant',), max_label_values=n)\n"
+    )
+    assert not [f for f in lint_source(src, "k.py") if f.rule == "LX009"]
+
+
+def test_lx009_fires_on_unbudgeted_dict_idiom():
+    # The shared-kwargs dict form (tk = dict(...)) must be checked at
+    # the dict, where the budget omission actually lives.
+    src = (
+        "def wire(r):\n"
+        "    tk = dict(labelnames=('tenant',))\n"
+        "    r.counter('tenant_requests_total', 'h', **tk)\n"
+    )
+    assert [f.rule for f in lint_source(src, "k.py")] == ["LX009"]
+    literal = (
+        "def wire(r):\n"
+        "    tk = {'labelnames': ('tenant',)}\n"
+        "    r.counter('tenant_requests_total', 'h', **tk)\n"
+    )
+    assert [f.rule for f in lint_source(literal, "k.py")] == ["LX009"]
+
+
+def test_lx009_ignores_non_tenant_labels():
+    src = (
+        "def wire(r):\n"
+        "    return r.counter('serve_http_requests_total', 'h',\n"
+        "                     labelnames=('route', 'code'))\n"
+    )
+    assert not [f for f in lint_source(src, "k.py") if f.rule == "LX009"]

@@ -213,3 +213,98 @@ def test_secure_chat_permission_gate():
     token = sec.authenticate("bob01", "correct-horse1")
     out = chat.secure_respond("hello", token)
     assert not out["ok"] and "permission" in out["error"]
+
+
+# -- token bucket (tenant QoS admission; injected clock, no sleeps) ---------
+def test_token_bucket_burst_then_refill():
+    from luminaai_tpu.security import TokenBucket
+
+    now = [100.0]
+    b = TokenBucket(rate_per_s=2.0, burst=4, clock=lambda: now[0])
+    # Burst: exactly `burst` requests pass back-to-back, the next is cut.
+    assert [b.allow() for _ in range(5)] == [True] * 4 + [False]
+    assert b.retry_after() == pytest.approx(0.5)
+    # Refill is continuous at rate_per_s: +0.5s -> one token.
+    now[0] += 0.5
+    assert b.allow() and not b.allow()
+    # Idle refill caps at burst (never exceeds it).
+    now[0] += 1000.0
+    assert [b.allow() for _ in range(5)] == [True] * 4 + [False]
+
+
+def test_token_bucket_limiter_isolates_tenants():
+    from luminaai_tpu.security import TokenBucketLimiter
+
+    now = [0.0]
+    lim = TokenBucketLimiter(rate_per_s=1.0, burst=2, clock=lambda: now[0])
+    assert lim.allow("t-a") and lim.allow("t-a") and not lim.allow("t-a")
+    # Tenant b's bucket is untouched by a's exhaustion.
+    assert lim.allow("t-b")
+    assert lim.remaining("t-a") == pytest.approx(0.0)
+    assert lim.retry_after("t-a") == pytest.approx(1.0)
+    now[0] += 2.0
+    assert lim.allow("t-a")
+
+
+def test_limiter_keys_are_hashed_tenants_not_raw_identities():
+    """The serving gate keys limiter state by tenant_hash(user); raw
+    identities must never appear in bucket keys (the limiter dict is
+    introspectable/dumpable state)."""
+    from luminaai_tpu.security import TokenBucketLimiter, tenant_hash
+
+    lim = TokenBucketLimiter(rate_per_s=10, burst=10)
+    user = "alice@example.com"
+    lim.allow(tenant_hash(user))
+    assert user not in lim._buckets
+    assert tenant_hash(user) in lim._buckets
+    assert all(len(k) == 12 for k in lim._buckets)
+
+
+# -- validator edge cases ---------------------------------------------------
+def test_validator_rejects_non_string_and_too_many_messages():
+    v = InputValidator(max_messages=2)
+    assert not v.validate_user_input(42).valid
+    assert not v.validate_user_input("   ").valid
+    conv = {"messages": [{"role": "user", "content": "x"}] * 3}
+    r = v.validate_conversation(conv)
+    assert not r.valid and any("too many" in e for e in r.errors)
+
+
+def test_validator_nfc_normalization_and_warnings():
+    v = InputValidator()
+    # NFC: decomposed e + combining acute collapses to é.
+    r = v.validate_user_input("café")
+    assert r.valid and r.sanitized == "café"
+    r2 = v.validate_user_input("run <script>alert(1)</script>")
+    assert r2.valid and any("suspicious" in w for w in r2.warnings)
+
+
+def test_validator_boundary_length_exact():
+    v = InputValidator(max_content_chars=5)
+    assert v.validate_user_input("x" * 5).valid
+    assert not v.validate_user_input("x" * 6).valid
+
+
+def test_token_bucket_limiter_bounds_bucket_count():
+    """Review fix: rotating tenant identities must not grow limiter
+    state without bound — idle (fully-refilled) buckets are swept at
+    the cap."""
+    from luminaai_tpu.security import TokenBucketLimiter
+
+    now = [0.0]
+    lim = TokenBucketLimiter(
+        rate_per_s=1.0, burst=2, clock=lambda: now[0], max_buckets=8
+    )
+    for i in range(32):
+        assert lim.allow(f"tenant-{i:04d}")
+        now[0] += 10.0  # earlier buckets fully refill (idle)
+    assert len(lim._buckets) <= 8
+    # An exhausted (non-idle) bucket survives the sweep over idle ones.
+    now[0] += 0.1
+    lim.allow("hot")
+    lim.allow("hot")
+    assert not lim.allow("hot")
+    for i in range(10):
+        lim.allow(f"fresh-{i}")
+    if "hot" in lim._buckets:
+        assert lim._buckets["hot"].tokens < 2

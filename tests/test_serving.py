@@ -1274,3 +1274,171 @@ def test_speculative_stream_window_degrade_keeps_deadline():
         prompt, max_new_tokens=8, seed=0
     ))
     assert items[-1]["stopped"] in ("eos", "length")
+
+
+# ---------------------------------------------------------------------------
+# tenant QoS: fair-share admission + token-bucket gate + identity hygiene
+# ---------------------------------------------------------------------------
+def test_wrr_dequeue_interleaves_tenants():
+    """Weighted round-robin dequeue: a hot tenant's flood alternates
+    with other tenants' requests instead of draining first; weights
+    grant extra dequeues per rotation (priority lanes)."""
+    from luminaai_tpu.serving.server import ContinuousScheduler
+
+    sched = ContinuousScheduler(
+        FakeContinuousEngine(), decoder=FakeStepper(num_slots=2),
+        tenant_weights={"vip": 2},
+    )
+    # The worker thread is parked in q.get(); the tenant queues are
+    # worker-side state we can drive directly for a deterministic
+    # dequeue-order check.
+    def req(tenant, i):
+        r = sched._make_request([i], {"tenant": tenant}, stream=False)
+        return r
+
+    for i in range(4):
+        sched._enqueue_tenant(req("hot", i))
+    for i in range(2):
+        sched._enqueue_tenant(req("cold", 10 + i))
+    for i in range(2):
+        sched._enqueue_tenant(req("vip", 20 + i))
+    order = []
+    while True:
+        nxt = sched._next_queued()
+        if nxt is None:
+            break
+        order.append(nxt.tenant)
+    assert len(order) == 8
+    # One rotation serves every tenant before hot's flood repeats: both
+    # cold requests and both vip requests land in the first 2 rotations.
+    assert order.index("cold") < 3
+    assert order[:5].count("hot") <= 2
+    # vip (weight 2) drains both its requests inside one rotation.
+    first_vip = order.index("vip")
+    assert order[first_vip + 1] == "vip" or order.count("vip") == 2
+    assert sched.queue_depth() == 0
+
+
+def test_fair_share_keeps_starved_tenant_draining():
+    """Acceptance: under an injected hot-tenant flood, the starved
+    tenant's queue keeps draining — its requests complete before the
+    flood's tail."""
+    import time as _time
+
+    from luminaai_tpu.serving.server import ContinuousScheduler
+
+    sched = ContinuousScheduler(
+        FakeContinuousEngine(), decoder=FakeStepper(num_slots=1)
+    )
+    done = []
+    lock = threading.Lock()
+
+    def hit(tenant, tok, budget):
+        sched.submit([tok], {"max_new_tokens": budget, "tenant": tenant})
+        with lock:
+            done.append(tenant)
+
+    # A blocker occupies the single slot while the flood + starved
+    # tenant enqueue behind it.
+    blocker = threading.Thread(target=hit, args=("hot", 50, 60))
+    blocker.start()
+    _time.sleep(0.1)
+    threads = [
+        threading.Thread(target=hit, args=("hot", 100 + i, 3))
+        for i in range(6)
+    ] + [
+        threading.Thread(target=hit, args=("starved", 200 + i, 3))
+        for i in range(2)
+    ]
+    for t in threads:
+        t.start()
+    for t in [blocker] + threads:
+        t.join(timeout=60)
+    assert len(done) == 9
+    # Both starved completions land before the flood's tail: WRR admits
+    # starved's requests in the first rotations after the blocker.
+    last_starved = max(i for i, t in enumerate(done) if t == "starved")
+    assert last_starved <= 6, done
+
+
+def test_tenant_token_bucket_gate_429s_and_recovers():
+    srv = ChatServer(FakeEngine(), tenant_rate_per_s=100.0, tenant_burst=2)
+    # Deterministic clock for the bucket.
+    now = [0.0]
+    srv.tenant_bucket.clock = lambda: now[0]
+    srv.tenant_bucket._buckets.clear()
+    ok1 = srv.handle("POST", "/v1/generate", {"prompt": "a"}, None)
+    ok2 = srv.handle("POST", "/v1/generate", {"prompt": "b"}, None)
+    limited = srv.handle("POST", "/v1/generate", {"prompt": "c"}, None)
+    assert ok1[0] == 200 and ok2[0] == 200
+    assert limited[0] == 429
+    assert "retry_after" in limited[1]
+    now[0] += 1.0  # 100 tokens/s refill
+    assert srv.handle("POST", "/v1/generate", {"prompt": "d"}, None)[0] == 200
+
+
+def test_secure_gate_limiter_keys_are_hashed_tenants():
+    """Satellite: the gate's limiter state is keyed by tenant_hash, so
+    raw usernames never appear in limiter keys."""
+    from luminaai_tpu.security.auth import tenant_hash
+
+    srv = ChatServer(
+        FakeEngine(), secure=True,
+        bootstrap_user=("alice", "correct-horse1"),
+        users_path="/dev/null",
+    )
+    code, payload = srv.handle(
+        "POST", "/v1/auth",
+        {"user": "alice", "password": "correct-horse1"}, None,
+    )
+    assert code == 200
+    token = payload["token"]
+    code, _ = srv.handle("POST", "/v1/chat", {"message": "hi"}, token)
+    assert code == 200
+    keys = list(srv.limiter._events)
+    assert keys, "limiter recorded nothing"
+    assert all(ident == tenant_hash("alice") for ident, _ in keys)
+    assert all(ident != "alice" for ident, _ in keys)
+
+
+def test_microbatcher_fallback_tenant_accounting_parity():
+    """Satellite: identity riders thread through MicroBatcher.submit —
+    per-tenant /metrics series and lifecycle events match the
+    continuous path for the same workload."""
+    from luminaai_tpu.monitoring.events import FlightRecorder
+    from luminaai_tpu.monitoring.telemetry import MetricsRegistry
+
+    workload = [{"prompt": "hello"}, {"prompt": "worlds"}]
+
+    def run(engine, continuous):
+        reg = MetricsRegistry()
+        rec = FlightRecorder(capacity=256)
+        srv = ChatServer(
+            engine, continuous=continuous, registry=reg, recorder=rec
+        )
+        for body in workload:
+            code, payload = srv.handle(
+                "POST", "/v1/generate", dict(body), None
+            )
+            assert code == 200
+            assert payload["request_id"]
+            assert payload["tenant"] == "anon"
+        snap = reg.snapshot()
+        return {
+            "requests": snap["tenant_requests_total"].get("tenant=anon"),
+            "tokens_in": snap["tenant_tokens_in_total"].get("tenant=anon"),
+            "tokens_out": snap["tenant_tokens_out_total"].get(
+                "tenant=anon"
+            ),
+        }, rec
+
+    cont, _ = run(FakeContinuousEngine(), True)
+    legacy, rec = run(FakeEngine(), False)
+    assert cont == legacy
+    # The fallback path emits the same lifecycle spine, tagged with its
+    # scheduler (riders stripped in submit, never reaching the engine).
+    admitted = rec.snapshot(type="request_admitted")
+    completed = rec.snapshot(type="request_completed")
+    assert len(admitted) == 2 and len(completed) == 2
+    assert all(e["scheduler"] == "micro_batch" for e in admitted)
+    assert all(e.get("tenant") == "anon" for e in completed)
