@@ -487,6 +487,20 @@ def _child_main(name: str) -> None:
                 "available": False,
                 "reason": "child budget exhausted before surface audit",
             }
+        # Cross-host expert dispatch (ROADMAP item 3): the comms
+        # auditor's a2a-vs-replicated-gather DCN byte comparison on a
+        # simulated dcn2 x ici4 mesh (subprocess with 8 virtual CPU
+        # devices — this child runs single-device). CI asserts the a2a
+        # path's dcn-crossing payload bytes strictly below the
+        # replicated gather's (docs/parallelism.md "Expert
+        # parallelism"). Budget-guarded like the audits above.
+        if not budget or time.perf_counter() - child_t0 < 0.8 * budget:
+            ex["ep_dispatch"] = _smoke_ep_dispatch()
+        else:
+            ex["ep_dispatch"] = {
+                "available": False,
+                "reason": "child budget exhausted before ep-dispatch audit",
+            }
         from luminaai_tpu.training.optimizer import describe_optimizer_memory
 
         ex["optimizer_memory"] = describe_optimizer_memory(state.opt_state)
@@ -1537,6 +1551,50 @@ def _smoke_dispatch_flops(registry=None) -> dict:
             "reduction": round(reduction, 4),
             "meets_10pct_target": bool(reduction >= 0.10),
         }
+    except Exception as e:
+        return {"available": False, "reason": f"{type(e).__name__}: {e}"}
+
+
+def _smoke_ep_dispatch() -> dict:
+    """Expert-dispatch comms audit for the smoke artifact (--smoke
+    only): analysis/jaxpr_audit.audit_ep_dispatch traces the a2a MoE
+    layer and the replicated-gather (gmm) baseline on a simulated
+    dcn2×ici4 mesh and prices each path's DCN-crossing payload bytes.
+    Runs in a SUBPROCESS with 8 virtual CPU devices — the smoke child
+    itself is single-device, and the device count is fixed at backend
+    init. Abstract traces only; nothing executes in the child either."""
+    code = (
+        "import json\n"
+        "from luminaai_tpu.analysis.jaxpr_audit import audit_ep_dispatch\n"
+        "print(json.dumps(audit_ep_dispatch()))\n"
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=300,
+            env=env,
+            cwd=_HERE,
+        )
+        if proc.returncode != 0:
+            err = (proc.stderr or "").strip().splitlines()
+            return {
+                "available": False,
+                "reason": (
+                    f"audit subprocess rc={proc.returncode}: "
+                    f"{err[-1][-300:] if err else 'no stderr'}"
+                ),
+            }
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except subprocess.TimeoutExpired:
+        return {"available": False, "reason": "audit subprocess timeout"}
     except Exception as e:
         return {"available": False, "reason": f"{type(e).__name__}: {e}"}
 

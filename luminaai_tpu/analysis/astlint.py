@@ -1,4 +1,4 @@
-"""AST lint engine: repo-specific JAX correctness rules (LX001..LX009).
+"""AST lint engine: repo-specific JAX correctness rules (LX001..LX010).
 
 A small, dependency-free rule framework over `ast`: each rule is a
 callable over a parsed file that yields findings; the engine applies
@@ -20,6 +20,9 @@ narrow-scope (precise on THIS codebase) rather than general-purpose:
   LX008  bare `except:` that would swallow XlaRuntimeError
   LX009  tenant-labeled metric family without a max_label_values
          budget (unbounded /metrics cardinality)
+  LX010  direct `lax.all_to_all` / `lax.ppermute` use outside
+         parallel/ (collective call sites must stay enumerable for
+         the comms auditor and the hierarchical dispatch plan)
 
 The jit-context detector (which functions end up traced) is shared by
 LX002/LX003/LX004 and intentionally over-approximates: decorated
@@ -917,6 +920,47 @@ def _check_lx009(ctx: FileContext) -> Iterator[Finding]:
 
 
 # --------------------------------------------------------------------------
+# LX010 — raw collectives outside parallel/
+# --------------------------------------------------------------------------
+
+_COLLECTIVE_NAMES = ("all_to_all", "ppermute")
+
+
+def _check_lx010(ctx: FileContext) -> Iterator[Finding]:
+    """Direct `lax.all_to_all` / `lax.ppermute` use outside `parallel/`:
+    explicit collectives must route through parallel/mesh.all_to_all /
+    ppermute (or the expert-dispatch subsystem built on them) so every
+    collective call site stays enumerable — the comms auditor
+    (analysis/jaxpr_audit.enumerate_collectives) and the hierarchical
+    dispatch groups both depend on knowing where collectives enter
+    model code. Mirrors LX001's shard_map rule."""
+    p = "/" + ctx.path.replace("\\", "/")
+    if "/parallel/" in p:
+        return
+    msg = (
+        "direct {name} use: route through luminaai_tpu.parallel.mesh."
+        "{name} — collective call sites outside parallel/ escape the "
+        "comms auditor and the hierarchical dispatch plan"
+    )
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod in ("jax.lax", "jax._src.lax.parallel") and any(
+                a.name in _COLLECTIVE_NAMES for a in node.names
+            ):
+                hit = next(
+                    a.name for a in node.names
+                    if a.name in _COLLECTIVE_NAMES
+                )
+                yield ctx.finding(LX010, node, msg.format(name=hit))
+        elif isinstance(node, ast.Call):
+            dotted = _dotted(node.func)
+            for name in _COLLECTIVE_NAMES:
+                if dotted in (f"lax.{name}", f"jax.lax.{name}"):
+                    yield ctx.finding(LX010, node, msg.format(name=name))
+
+
+# --------------------------------------------------------------------------
 # registry / engine
 # --------------------------------------------------------------------------
 
@@ -965,9 +1009,15 @@ LX009 = Rule(
     "tenant-labeled metric family without max_label_values budget",
     _check_lx009,
 )
+LX010 = Rule(
+    "LX010", "raw-collective-outside-parallel", SEVERITY_ERROR,
+    "direct lax.all_to_all/lax.ppermute outside parallel/",
+    _check_lx010,
+)
 
 ALL_RULES: Tuple[Rule, ...] = (
     LX001, LX002, LX003, LX004, LX005, LX006, LX007, LX008, LX009,
+    LX010,
 )
 
 RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in ALL_RULES}

@@ -968,6 +968,27 @@ def cmd_diagnose(args) -> int:
             )
     except Exception as e:
         print(f"connectivity probe unavailable: {e}")
+    # Expert-dispatch rung: a REAL timed two-stage (ici-then-dcn)
+    # all-to-all over the probe mesh — the hierarchical exchange the
+    # a2a MoE dispatch runs (parallel/expert_dispatch.py), priced per
+    # stage for the MULTICHIP_r* harness. Single-host fleets simulate
+    # the dcn tier so the two-stage path is still exercised; exported
+    # as diagnose_expert_a2a_seconds{stage} gauges.
+    try:
+        from luminaai_tpu.parallel.expert_dispatch import expert_a2a_probe
+
+        a2a = expert_a2a_probe()
+        print("[expert-a2a]")
+        print(
+            f"  mesh: ep={a2a['ep']} (dcn={a2a['dcn']} x ici={a2a['ici']}"
+            f"{', simulated dcn' if a2a.get('simulated_dcn') else ''})"
+        )
+        for stage, rec in a2a["stages"].items():
+            print(f"  {stage}:")
+            for k, v in rec.items():
+                print(f"    {k}: {v}")
+    except Exception as e:
+        print(f"expert-a2a probe unavailable: {e}")
     try:
         print(f"recommended preset for this fleet: {recommend_preset()}")
         if args.preset:

@@ -132,8 +132,23 @@ class Config:
     # GATHER through an inverted slot→token index table (the H-wide scatter
     # moves to the backward pass — TPUs execute row gathers much better);
     # 'einsum' = GShard one-hot dispatch (O(S·E·C) memory, MXU-only data
-    # movement — useful for A/B in bench_ops).
+    # movement — useful for A/B in bench_ops);
+    # 'a2a' = cross-host expert parallelism: tokens shard over
+    # (data, fsdp, expert) and are ROUTED to their experts' shards via
+    # the hierarchical (ici-then-dcn) all-to-all subsystem
+    # (parallel/expert_dispatch.py) — padding-free bucket payloads, no
+    # full-activation psum; requires an 'expert' mesh axis.
     moe_dispatch: str = "sort"
+    # a2a only: how much of the expert axis spans the DCN tier (hosts).
+    # expert_parallel_size must be divisible; 1 = single-stage fallback
+    # (everything on ICI). The two-stage exchange sends few large
+    # rail-aligned DCN messages per X-MoE (docs/parallelism.md).
+    expert_dcn_size: int = 1
+    # a2a only: split the bucket payload into this many chunks so each
+    # chunk's stage-2 (DCN) exchange is data-independent of the other
+    # chunks' expert FFN — XLA's latency-hiding scheduler overlaps
+    # comms with grouped-matmul compute. 1 disables.
+    moe_a2a_overlap_chunks: int = 2
     # Internal: explicit expert-axis activation constraints in MoELayer.
     # The pipeline builders flip this off inside the manual-pipe region
     # (XLA partitioner group-check crash); everywhere else leave True.
@@ -446,8 +461,50 @@ class Config:
                 f"invalid moe_pattern {self.moe_pattern}"
             )
             assert self.capacity_factor > 0
-            assert self.moe_dispatch in ("sort", "gather", "einsum", "gmm"), (
-                f"invalid moe_dispatch {self.moe_dispatch}"
+            assert self.moe_dispatch in (
+                "sort", "gather", "einsum", "gmm", "a2a"
+            ), f"invalid moe_dispatch {self.moe_dispatch}"
+            if self.moe_dispatch == "a2a":
+                # Cross-host expert parallelism routes tokens over the
+                # 'expert' mesh axis (parallel/expert_dispatch.py): the
+                # axis must exist, and the dcn tier must factor it.
+                assert self.expert_parallel_size > 1, (
+                    "moe_dispatch='a2a' requires an expert mesh axis "
+                    "(expert_parallel_size > 1) — token routing needs "
+                    "shards to route between; use 'gmm' on a single-"
+                    "host/no-ep mesh"
+                )
+                assert (
+                    self.expert_parallel_size % self.expert_dcn_size == 0
+                ), (
+                    f"expert_dcn_size ({self.expert_dcn_size}) must "
+                    f"divide expert_parallel_size "
+                    f"({self.expert_parallel_size})"
+                )
+                assert self.moe_a2a_overlap_chunks >= 1, (
+                    "moe_a2a_overlap_chunks must be >= 1"
+                )
+                for name, size in (
+                    ("pipeline", self.pipeline_parallel_size),
+                    ("sequence", self.sequence_parallel_size),
+                ):
+                    assert size == 1, (
+                        f"moe_dispatch='a2a' composes with data/fsdp/"
+                        f"expert/tensor mesh axes only ({name}_parallel_"
+                        f"size={size}); use 'gather' or 'sort' there"
+                    )
+                if self.tensor_parallel_size > 1:
+                    assert (
+                        self.intermediate_size % self.tensor_parallel_size
+                        == 0
+                    ), (
+                        "moe_dispatch='a2a' with tensor parallelism "
+                        "needs intermediate_size divisible by tensor_"
+                        f"parallel_size ({self.intermediate_size} % "
+                        f"{self.tensor_parallel_size})"
+                    )
+            assert self.expert_dcn_size >= 1, (
+                "expert_dcn_size must be >= 1"
             )
             if self.moe_dispatch == "gmm":
                 # The megablox grouped-matmul kernel is a Pallas custom

@@ -1054,6 +1054,12 @@ class StepwiseDecoder:
     one executable, the core of the continuous-batching win).
     """
 
+    # In-flight dedup safety bound: a parked follower proceeds cold
+    # after this many re-check ticks even if the pending entry never
+    # clears (release_slot clears leaked claims far sooner in practice;
+    # this only fences a pathological leader wedged mid-prefill).
+    DEDUP_WAIT_TICKS = 512
+
     def __init__(
         self,
         engine: GenerationEngine,
@@ -1195,6 +1201,11 @@ class StepwiseDecoder:
         # Arena page ids each lane currently references (released with
         # the slot in release_slot -> refcounts drop, pages survive).
         self._leases: Dict[int, List[int]] = {}
+        # In-flight dedup: chain keys each mid-prefill lane has claimed
+        # as the harvester (release_slot must unclaim them if the lane
+        # dies before its harvest, or followers park until their wait
+        # budget expires).
+        self._pending_claims: Dict[int, List[str]] = {}
         self._refresh_table()
 
     def _refresh_table(self) -> None:
@@ -1234,6 +1245,11 @@ class StepwiseDecoder:
             # identity so a stale alias can never ride into the next
             # occupant.
             self.prefix_cache.release(self._leases.pop(slot, []))
+            # A mid-prefill lane dying with unharvested pending claims
+            # must unblock its followers (they re-check and go cold).
+            claims = self._pending_claims.pop(slot, None)
+            if claims:
+                self.prefix_cache.release_pending(claims)
             self._reset_gtable_row(slot)
             self._refresh_table()
         self.pool.free(slot)
@@ -1570,7 +1586,16 @@ class StepwiseDecoder:
         so a cached 1000-token system prompt costs zero prefill FLOPs.
         At least one row is always recomputed (the last prompt row must
         produce logits to sample token #1), so a fully-cached prompt
-        still runs one chunk."""
+        still runs one chunk.
+
+        In-flight dedup (ROADMAP item 2): when this prompt's first
+        non-resident page is ALREADY being computed by another live
+        admission, the lane parks in a `waiting` state instead of
+        re-running the same prefill cold — advance_prefill re-checks
+        each tick and resolves to a genuine HIT once the leader's
+        harvest lands (or goes cold if the leader dies). Concurrent
+        identical prefixes before the first harvest thus share one
+        pending-insert entry instead of all missing."""
         if not self.prefill_chunk:
             return None
         sample_key = sample_key or GREEDY_SAMPLE_KEY
@@ -1583,8 +1608,12 @@ class StepwiseDecoder:
         L = len(prompt)
         chunk = self.prefill_chunk
         ps = self.pool.page_size
-        hit_ids: List[int] = []
-        hit_rows = 0
+        st: Dict[str, Any] = {
+            "slot": slot, "length": L, "chunk": chunk, "next": 0,
+            "n_chunks": 0, "sample_key": sample_key, "seed": seed,
+            "max_new": max_new, "prompt": prompt, "tenant": tenant,
+            "start_rows": 0, "p0": 0,
+        }
         if self.prefix_cache is not None:
             from luminaai_tpu.inference.prefix_cache import page_chain_keys
 
@@ -1596,14 +1625,20 @@ class StepwiseDecoder:
             chain = page_chain_keys(
                 prompt, self.pool.page_size, (L - 1) // ps
             )
+            st["chain"] = chain
             peek_keys, _ = self.prefix_cache.lookup(prompt, keys=chain)
+            if len(peek_keys) < len(chain) and (
+                self.prefix_cache.has_pending_prefix(chain)
+            ):
+                # Park behind the in-flight leader. Neither hit nor
+                # miss is booked yet — resolution does the acquire.
+                self.prefix_cache.note_dedup_wait()
+                st["waiting"] = True
+                st["wait_ticks"] = 0
+                self._park_lane(slot, 0)
+                return st
             if L <= chunk and not peek_keys:
                 return None
-            # Pin before splicing: an acquired page cannot be evicted
-            # until release_slot drops the lease. (Counts the hit/miss.)
-            hit_ids, hit_rows = self.prefix_cache.acquire(
-                prompt, keys=chain
-            )
         elif L <= chunk:
             # A one-chunk prompt can't stall anyone longer than a chunk
             # anyway, and the bucketed prefill_into_slot path moves only
@@ -1612,6 +1647,43 @@ class StepwiseDecoder:
             # (Prefix HITS always take the chunked path: the splice +
             # suffix-only prefill only exists here.)
             return None
+        self._arm_prefill(st)
+        return st
+
+    def _park_lane(self, slot: int, rows: int) -> None:
+        """Interleaved decode steps still write one (garbage) row at
+        _pos for every lane, active or not; park the mid-prefill
+        lane's write row at the slot's LAST row — admission bounds
+        prompts to token_capacity - 1, so no chunk writes it, and a
+        lane that eventually decodes there overwrites it before its
+        mask first admits it. (The last row is always a PRIVATE page:
+        splices cover at most (L-1)//ps full pages.)"""
+        self._pos[slot] = self.slot_tokens - 1
+        self._active[slot] = False
+        self.pool.lengths[slot] = rows
+
+    def _arm_prefill(self, st: Dict[str, Any]) -> None:
+        """Resolve a prefill state into a runnable one: pin + splice the
+        cached prefix (books the hit/miss), claim the non-resident tail
+        for this lane's harvest (in-flight dedup), size the chunk ids
+        buffer, park the lane. Shared by the immediate start_prefill
+        path and advance_prefill's waiting-state resolution."""
+        slot, prompt, L = st["slot"], st["prompt"], st["length"]
+        chunk = st["chunk"]
+        hit_ids: List[int] = []
+        hit_rows = 0
+        if self.prefix_cache is not None:
+            chain = st["chain"]
+            # Pin before splicing: an acquired page cannot be evicted
+            # until release_slot drops the lease. (Counts the hit/miss.)
+            hit_ids, hit_rows = self.prefix_cache.acquire(
+                prompt, keys=chain
+            )
+            st["pending_keys"] = self.prefix_cache.claim_pending(
+                chain, owner=slot
+            )
+            if st["pending_keys"]:
+                self._pending_claims[slot] = st["pending_keys"]
         n = -(-(L - hit_rows) // chunk)
         ids = np.zeros((1, hit_rows + n * chunk), np.int32)
         ids[0, :L] = prompt
@@ -1621,25 +1693,13 @@ class StepwiseDecoder:
                 hit_ids, np.int32
             )
             self._refresh_table()
-        # Interleaved decode steps still write one (garbage) row at
-        # _pos for every lane, active or not; park the mid-prefill
-        # lane's write row at the slot's LAST row — admission bounds
-        # prompts to token_capacity - 1, so no chunk writes it, and a
-        # lane that eventually decodes there overwrites it before its
-        # mask first admits it. (The last row is always a PRIVATE page:
-        # splices cover at most (L-1)//ps full pages.)
-        self._pos[slot] = self.slot_tokens - 1
-        self._active[slot] = False
-        self.pool.lengths[slot] = hit_rows
+        self._park_lane(slot, hit_rows)
         if self.prefix_cache is None:
             self._refresh_table()
-        return {
-            "slot": slot, "ids": ids, "length": L, "chunk": chunk,
-            "next": 0, "n_chunks": n, "sample_key": sample_key,
-            "seed": seed, "max_new": max_new,
-            "prompt": prompt, "tenant": tenant,
-            "start_rows": hit_rows, "p0": len(hit_ids),
-        }
+        st.update(
+            ids=ids, n_chunks=n, start_rows=hit_rows, p0=len(hit_ids)
+        )
+        st.pop("waiting", None)
 
     def advance_prefill(
         self, st: Dict[str, Any]
@@ -1652,7 +1712,25 @@ class StepwiseDecoder:
 
         Chunks start at `start_rows` (the spliced prefix extent, 0 when
         cold) — the suffix-only prefill that turns a prefix hit into
-        skipped FLOPs."""
+        skipped FLOPs.
+
+        A `waiting` state (in-flight dedup, see start_prefill) burns a
+        tick re-checking the leader instead of computing: once the
+        leader's harvest lands the acquire books a real HIT and the
+        suffix-only prefill runs; if the leader dies (release_pending
+        in release_slot) or the wait budget expires, the lane proceeds
+        cold. Either way no chunk FLOPs are spent while parked."""
+        if st.get("waiting"):
+            st["wait_ticks"] += 1
+            cache = self.prefix_cache
+            if (
+                cache is not None
+                and cache.has_pending_prefix(st["chain"])
+                and st["wait_ticks"] < self.DEDUP_WAIT_TICKS
+            ):
+                return None
+            self._arm_prefill(st)
+            # Fall through: this tick runs the first real chunk.
         c = st["next"]
         chunk = st["chunk"]
         slot = st["slot"]
@@ -1695,11 +1773,18 @@ class StepwiseDecoder:
         )
         if self.prefix_cache is not None:
             harvested = self._harvest(slot, st)
+            # Harvest landed (or failed and was unwound): release this
+            # lane's pending claims so parked followers resolve — to a
+            # hit in the first case, cold in the second.
+            claims = self._pending_claims.pop(slot, None)
+            if claims:
+                self.prefix_cache.release_pending(claims)
             info["prefix"] = {
                 "hit_pages": int(st.get("p0", 0)),
                 "tokens_saved": base,
                 "pages_harvested": harvested,
                 "tenant": st.get("tenant", "anon"),
+                "dedup_wait_ticks": int(st.get("wait_ticks", 0)),
             }
         return info
 

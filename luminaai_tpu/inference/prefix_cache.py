@@ -131,6 +131,13 @@ class RadixPrefixCache:
         self.tokens_saved = 0
         self.pages_spliced = 0
         self._tenant_pages: Dict[str, int] = {}
+        # In-flight dedup (ROADMAP item 2): chain keys whose pages are
+        # being computed by a live admission RIGHT NOW. A concurrent
+        # identical prefix parks behind the pending entry instead of
+        # re-running the whole prefill cold — before this, N same-prefix
+        # admissions landing before the first harvest all missed.
+        self._pending: Dict[str, int] = {}
+        self.dedup_waits = 0
 
     # -- lookup / pin ------------------------------------------------------
     def lookup(
@@ -325,6 +332,51 @@ class RadixPrefixCache:
                 removed += 1
         return removed
 
+    # -- in-flight dedup ---------------------------------------------------
+    def has_pending_prefix(self, keys: Sequence[str]) -> bool:
+        """True when this prompt's FIRST non-resident page is being
+        computed by another live admission — the caller should park and
+        re-check instead of prefilling the same prefix cold."""
+        with self._lock:
+            for key in keys:
+                if key in self._index:
+                    continue
+                return key in self._pending
+            return False
+
+    def claim_pending(
+        self, keys: Sequence[str], owner: int = 0
+    ) -> List[str]:
+        """Claim the non-resident tail of this chain for the caller's
+        harvest. Stops at a key another admission already owns (its
+        harvest will cover it). Returns the claimed keys; the caller
+        MUST release_pending() them when its harvest lands or its lane
+        dies — a leaked claim would park followers until their wait
+        budget expires."""
+        with self._lock:
+            out: List[str] = []
+            for key in keys:
+                if key in self._index:
+                    continue
+                if key in self._pending:
+                    break
+                self._pending[key] = owner
+                out.append(key)
+            return out
+
+    def release_pending(self, keys: Sequence[str]) -> None:
+        with self._lock:
+            for key in keys:
+                self._pending.pop(key, None)
+
+    def note_dedup_wait(self) -> None:
+        with self._lock:
+            self.dedup_waits += 1
+
+    def pending_pages(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
     # -- introspection -----------------------------------------------------
     def pages_cached(self) -> int:
         with self._lock:
@@ -361,4 +413,6 @@ class RadixPrefixCache:
                 "pages_spliced": self.pages_spliced,
                 "tenant_quota": self.tenant_quota,
                 "tenants": dict(self._tenant_pages),
+                "pending_pages": len(self._pending),
+                "dedup_waits": self.dedup_waits,
             }

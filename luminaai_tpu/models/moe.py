@@ -304,10 +304,25 @@ class MoELayer(nn.Module):
         # weights route through the gather buffers (decode shapes rarely
         # satisfy gmm's 128-row tiling anyway).
         dispatch_mode = cfg.moe_dispatch
-        if isinstance(wi, QuantizedTensor) and dispatch_mode == "gmm":
+        if isinstance(wi, QuantizedTensor) and dispatch_mode in (
+            "gmm", "a2a"
+        ):
             dispatch_mode = "gather"
 
-        if dispatch_mode == "gmm":
+        ep_stats: Dict[str, jax.Array] = {}
+        if dispatch_mode == "a2a":
+            # Cross-host expert parallelism (ROADMAP item 3 / X-MoE):
+            # tokens shard over (data, fsdp, expert) and are ROUTED to
+            # their experts' shards through the hierarchical all-to-all
+            # subsystem (parallel/expert_dispatch.py) — padding-free
+            # buckets, ici-then-dcn staging, no full-activation psum.
+            # Routing semantics are _sort_routing's, so outputs match
+            # the replicated-gather path (parity-pinned in
+            # tests/test_expert_dispatch.py).
+            out, tokens_per_expert, dropped, ep_stats = self._a2a_path(
+                x, router_probs, wi, wo, capacity
+            )
+        elif dispatch_mode == "gmm":
             # Ragged grouped matmul via the Pallas megablox kernel: tokens
             # sorted by expert, each expert's FFN runs over exactly its
             # kept rows — no [E, G, C, H] capacity-padded buffers and no
@@ -378,7 +393,7 @@ class MoELayer(nn.Module):
                 "gsec->e", dispatch.astype(jnp.float32)
             )
 
-        if dispatch_mode != "gmm":
+        if dispatch_mode not in ("gmm", "a2a"):
             # Manual expert parallelism (inside the 1F1B manual-pipe region):
             # tokens arrive SHARDED over the 'expert' mesh axis (ep borrows the
             # data dimension, the DeepSpeed-MoE layout), this shard's wi/wo
@@ -387,8 +402,11 @@ class MoELayer(nn.Module):
             manual_ep = cfg.moe_manual_ep and cfg.expert_parallel_size > 1
             if manual_ep:
                 # [E, G, C, H] -> [E/ep, ep*G, C, H]: split experts to their
-                # owners, gather all shards' token groups.
-                expert_in = jax.lax.all_to_all(
+                # owners, gather all shards' token groups. (Routed through
+                # parallel/mesh.all_to_all — the LX010 entry point.)
+                from luminaai_tpu.parallel.mesh import all_to_all
+
+                expert_in = all_to_all(
                     expert_in, "expert", split_axis=0, concat_axis=1, tiled=True
                 )
             elif cfg.moe_ep_constraints:
@@ -422,7 +440,9 @@ class MoELayer(nn.Module):
             if manual_ep:
                 # [E/ep, ep*G, C, H] -> [E, G, C, H]: every token group gets
                 # all experts' outputs back for the local combine.
-                expert_out = jax.lax.all_to_all(
+                from luminaai_tpu.parallel.mesh import all_to_all
+
+                expert_out = all_to_all(
                     expert_out, "expert", split_axis=1, concat_axis=0, tiled=True
                 )
             elif cfg.moe_ep_constraints:
@@ -485,6 +505,11 @@ class MoELayer(nn.Module):
             # the kept mass so capacity drops don't masquerade as balance.
             "moe_max_expert_share": jnp.max(f) / (jnp.sum(f) + 1e-9),
         }
+        # a2a dispatch stats: global routed-token counts per hierarchy
+        # stage (every kept pair rides stage 1; only host-crossing pairs
+        # ride the dcn stage). trainer._export_router_health turns these
+        # into ep_dispatch_tokens_total and router_health events.
+        metrics.update(ep_stats)
         return out.astype(self.dtype), metrics
 
     def _gmm_path(
@@ -616,6 +641,163 @@ class MoELayer(nn.Module):
                 P("expert", "tensor", None),
             ),
             out_specs=(tok_spec, P(), P(("data", "fsdp"), None)),
+            check_vma=False,
+        )
+        return sharded(x, router_probs, wi[..., :F], wi[..., F:], wo)
+
+
+    def _a2a_path(
+        self, x: jax.Array, router_probs: jax.Array, wi, wo, capacity: int
+    ) -> Tuple[jax.Array, jax.Array, jax.Array, Dict[str, jax.Array]]:
+        """Routed expert FFN via the hierarchical all-to-all subsystem
+        (parallel/expert_dispatch.py — design rationale lives there).
+
+        Layout contract vs the gmm path: tokens shard over
+        ('data', 'fsdp', 'expert') — EP borrows the data dimension, so
+        each expert shard holds a DISTINCT token sub-batch and routes
+        it, instead of replicating the batch over the expert axis and
+        psum-ing full activations. That is what lets expert capacity
+        scale past one host: adding expert shards adds token shards,
+        and only routed tokens cross the dcn tier. tensor composes per
+        the PR 5 contract (gate/up column-parallel halves, wo
+        row-parallel, partial rows psum'd over 'tensor' before the
+        combine exchange). sequence/pipe are rejected by config.
+
+        Returns (out [G,S,H], tokens_per_expert [E] global, dropped
+        [G,S], stats {ep_tokens_routed, ep_tokens_dcn} global)."""
+        cfg = self.config
+        G, S, H = x.shape
+        E, k = cfg.num_experts, cfg.moe_top_k
+        gmm = _pick_gmm()
+
+        from luminaai_tpu.parallel.mesh import active_mesh, shard_map
+
+        mesh = active_mesh()
+        multi = mesh is not None and mesh.size > 1
+        ep = mesh.shape.get("expert", 1) if mesh is not None else 1
+        if not multi or self.is_initializing():
+            out, tpe, dropped = _gmm_local(
+                x, router_probs, wi, wo,
+                top_k=k, capacity=capacity, num_experts=E,
+                dtype=self.dtype, gmm_fn=gmm, ep_axis=None,
+            )
+            zero = jnp.float32(0.0)
+            return out, tpe, dropped, {
+                "ep_tokens_routed": zero, "ep_tokens_dcn": zero,
+            }
+        if ep == 1:
+            # An expert axis is required for routing (config.validate
+            # enforces it); a mesh that lost it at runtime still has a
+            # correct path — the gmm composition over data/fsdp.
+            out, tpe, dropped = self._gmm_path(
+                x, router_probs, wi, wo, capacity
+            )
+            zero = jnp.float32(0.0)
+            return out, tpe, dropped, {
+                "ep_tokens_routed": zero, "ep_tokens_dcn": zero,
+            }
+
+        for ax in ("sequence", "pipe"):
+            if mesh.shape.get(ax, 1) > 1:
+                raise ValueError(
+                    f"moe_dispatch='a2a' does not compose with the "
+                    f"'{ax}' mesh axis (size {mesh.shape[ax]}); use "
+                    "'gather' dispatch"
+                )
+        dp_total = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
+        tok_shards = dp_total * ep
+        if G % tok_shards != 0:
+            raise ValueError(
+                f"a2a dispatch needs batch groups ({G}) divisible by "
+                f"data*fsdp*expert ({tok_shards}) — EP borrows the "
+                "data dimension"
+            )
+        tp = mesh.shape.get("tensor", 1)
+        dcn = max(1, int(getattr(cfg, "expert_dcn_size", 1)))
+
+        from luminaai_tpu.parallel.expert_dispatch import (
+            a2a_expert_ffn,
+            export_plan_gauges,
+            make_dispatch_plan,
+        )
+
+        plan = make_dispatch_plan(
+            ep=ep,
+            dcn_size=dcn,
+            local_groups=G // tok_shards,
+            seq=S,
+            top_k=k,
+            capacity=capacity,
+            num_experts=E,
+            hidden=H,
+            itemsize=jnp.dtype(self.dtype).itemsize,
+            overlap_chunks=max(1, int(getattr(
+                cfg, "moe_a2a_overlap_chunks", 1
+            ))),
+            dp_groups=G // dp_total,
+        )
+        export_plan_gauges(plan)
+
+        from jax.sharding import PartitionSpec as P
+
+        tok_spec = P(("data", "fsdp", "expert"), None, None)
+        tok_axes = ("data", "fsdp", "expert")
+
+        def finish(out, tpe, dropped, stats):
+            tpe = jax.lax.psum(tpe, tok_axes)
+            stats = {
+                name: jax.lax.psum(v, tok_axes)
+                for name, v in stats.items()
+            }
+            return out, tpe, dropped, stats
+
+        if tp == 1:
+            def body(x_l, probs_l, wi_l, wo_l):
+                return finish(*a2a_expert_ffn(
+                    x_l, probs_l, wi_l, wo_l,
+                    top_k=k, capacity=capacity, num_experts=E,
+                    dtype=self.dtype, gmm_fn=gmm, ep_axis="expert",
+                    plan=plan,
+                ))
+
+            sharded = shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(tok_spec, tok_spec, P("expert", None, None),
+                          P("expert", None, None)),
+                out_specs=(tok_spec, P(), P(tok_axes, None),
+                           {"ep_tokens_routed": P(),
+                            "ep_tokens_dcn": P()}),
+                check_vma=False,
+            )
+            return sharded(x, router_probs, wi, wo)
+
+        # expert x tensor: matched gate/up column slices + row-parallel
+        # wo, exactly the gmm path's decomposition (config.validate
+        # enforces F % tp == 0); the per-chunk psum over 'tensor' lives
+        # inside a2a_expert_ffn so only one output copy rides the
+        # combine exchange.
+        F = wi.shape[-1] // 2
+
+        def body_tp(x_l, probs_l, wi_g_l, wi_u_l, wo_l):
+            wi_l = jnp.concatenate([wi_g_l, wi_u_l], axis=-1)
+            return finish(*a2a_expert_ffn(
+                x_l, probs_l, wi_l, wo_l,
+                top_k=k, capacity=capacity, num_experts=E,
+                dtype=self.dtype, gmm_fn=gmm, ep_axis="expert",
+                plan=plan, tp_axis="tensor",
+            ))
+
+        sharded = shard_map(
+            body_tp,
+            mesh=mesh,
+            in_specs=(
+                tok_spec, tok_spec,
+                P("expert", None, "tensor"), P("expert", None, "tensor"),
+                P("expert", "tensor", None),
+            ),
+            out_specs=(tok_spec, P(), P(tok_axes, None),
+                       {"ep_tokens_routed": P(), "ep_tokens_dcn": P()}),
             check_vma=False,
         )
         return sharded(x, router_probs, wi[..., :F], wi[..., F:], wo)

@@ -36,6 +36,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec
 
+from luminaai_tpu.parallel.mesh import ppermute
+
 NEG_INF = -1e30
 
 
@@ -126,8 +128,8 @@ def _ring_attention_shard_flash(
 
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
     for step in range(1, axis_size):
-        k = jax.lax.ppermute(k, axis_name, perm)
-        v = jax.lax.ppermute(v, axis_name, perm)
+        k = ppermute(k, axis_name, perm)
+        v = ppermute(v, axis_name, perm)
         kv_idx = (my_idx - step) % axis_size
         offset = (my_idx - kv_idx) * Sl  # q_pos - k_pos at matching rows
 
@@ -264,8 +266,8 @@ def _ring_attention_shard(
         else:
             m, l, o = update(qg, k, v, kv_idx, m, l, o)
         if step + 1 < axis_size:
-            k = jax.lax.ppermute(k, axis_name, perm)
-            v = jax.lax.ppermute(v, axis_name, perm)
+            k = ppermute(k, axis_name, perm)
+            v = ppermute(v, axis_name, perm)
 
     out = o / l[..., None]
     return out.astype(q.dtype).reshape(B, Sl, Hq, D)

@@ -133,6 +133,26 @@ def shard_map(f, mesh, in_specs, out_specs, axis_names=None, check_vma=None):
     )
 
 
+def all_to_all(x, axis_name, *, split_axis, concat_axis, tiled=False,
+               axis_index_groups=None):
+    """`lax.all_to_all`, the ONE entry point repo code outside parallel/
+    calls (astlint LX010). Keeping every explicit collective call site
+    routed through parallel/ keeps them enumerable — the comms auditor
+    (analysis/jaxpr_audit.enumerate_collectives) and the hierarchical
+    dispatch groups (parallel/expert_dispatch.py) both rely on knowing
+    where collectives enter model code."""
+    return jax.lax.all_to_all(
+        x, axis_name, split_axis=split_axis, concat_axis=concat_axis,
+        tiled=tiled, axis_index_groups=axis_index_groups,
+    )
+
+
+def ppermute(x, axis_name, perm):
+    """`lax.ppermute` through the same sanctioned entry point (LX010) —
+    ring attention's KV rotation and the pipeline's stage hops."""
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
 # Explicit registry for the mesh the current trace runs under. The train
 # step factories push here (use_mesh below); thread_resources is only a
 # legacy fallback for code that entered `with mesh:` directly.

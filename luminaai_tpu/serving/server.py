@@ -965,49 +965,67 @@ class ContinuousScheduler:
         """Advance ONE chunk of ONE mid-prefill admission (round-robin
         in admission order). Called once per scheduler tick, so prefill
         work interleaves with decode steps instead of stalling them —
-        the chunked-prefill latency contract (docs/serving.md)."""
-        if not self._prefilling:
+        the chunked-prefill latency contract (docs/serving.md).
+
+        Dedup followers parked behind an in-flight identical prefix
+        (decoder `waiting` states) re-check for free but must NOT eat
+        the tick's single chunk advance — otherwise K parked followers
+        would slow their own leader's prefill (and every queued one)
+        (K+1)x. A parked re-check cycles to the ring's tail and the
+        scan moves on to the first runnable admission; only real chunk
+        compute (or a resolution running its first chunk) ends the
+        tick."""
+        for _ in range(max(1, len(self._prefilling))):
+            if not self._prefilling:
+                return
+            slot, (req, st, t_admit, spent) = next(
+                iter(self._prefilling.items())
+            )
+            del self._prefilling[slot]
+            if req.cancelled:
+                self._finish(req, "cancelled")
+                self._release_slot(slot)
+                return
+            if req.deadline is not None and time.time() > req.deadline:
+                self._timeout(req, "mid-prefill")
+                self._release_slot(slot)
+                return
+            was_waiting = bool(st.get("waiting"))
+            try:
+                t_chunk = time.perf_counter()
+                with self.tracer.span("prefill_chunk", slot=slot):
+                    info = self.decoder.advance_prefill(st)
+                spent += time.perf_counter() - t_chunk
+            except Exception as e:
+                logger.exception("chunked prefill failed")
+                self._release_slot(slot)
+                self._fail(req, e)
+                return
+            if info is None and was_waiting and st.get("waiting"):
+                # Still parked: host-only bookkeeping, no chunk ran and
+                # no prefill_chunk event/counter — keep scanning for
+                # runnable work this tick.
+                self._prefilling[slot] = (req, st, t_admit, spent)
+                continue
+            if self.telemetry:
+                self._m_prefill_chunks.inc()
+            self._event(
+                "prefill_chunk", req, slot=slot,
+                chunk=int(st["next"]), chunks=int(st["n_chunks"]),
+                # Rows RESIDENT, spliced prefix included — must agree with
+                # the decoder's own residency booking for a prefix hit.
+                rows=int(min(
+                    int(st.get("start_rows", 0)) + st["next"] * st["chunk"],
+                    st["length"],
+                )),
+            )
+            if info is None:
+                # More chunks pending: back of the round-robin ring.
+                self._prefilling[slot] = (req, st, t_admit, spent)
+                return
+            self._prefill_done(req, slot, info, t_admit, active,
+                               prefill_s=spent)
             return
-        slot, (req, st, t_admit, spent) = next(
-            iter(self._prefilling.items())
-        )
-        del self._prefilling[slot]
-        if req.cancelled:
-            self._finish(req, "cancelled")
-            self._release_slot(slot)
-            return
-        if req.deadline is not None and time.time() > req.deadline:
-            self._timeout(req, "mid-prefill")
-            self._release_slot(slot)
-            return
-        try:
-            t_chunk = time.perf_counter()
-            with self.tracer.span("prefill_chunk", slot=slot):
-                info = self.decoder.advance_prefill(st)
-            spent += time.perf_counter() - t_chunk
-        except Exception as e:
-            logger.exception("chunked prefill failed")
-            self._release_slot(slot)
-            self._fail(req, e)
-            return
-        if self.telemetry:
-            self._m_prefill_chunks.inc()
-        self._event(
-            "prefill_chunk", req, slot=slot,
-            chunk=int(st["next"]), chunks=int(st["n_chunks"]),
-            # Rows RESIDENT, spliced prefix included — must agree with
-            # the decoder's own residency booking for a prefix hit.
-            rows=int(min(
-                int(st.get("start_rows", 0)) + st["next"] * st["chunk"],
-                st["length"],
-            )),
-        )
-        if info is None:
-            # More chunks pending: back of the round-robin ring.
-            self._prefilling[slot] = (req, st, t_admit, spent)
-            return
-        self._prefill_done(req, slot, info, t_admit, active,
-                           prefill_s=spent)
 
     def _loop(self) -> None:
         while True:

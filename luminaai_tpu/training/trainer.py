@@ -1143,6 +1143,24 @@ class Trainer:
                 "Fraction of tokens losing >=1 routing slot to capacity "
                 "(capacity dispatch paths)",
             ).set(drop)
+        # a2a dispatch: per-stage routed-token counts (per-layer mean
+        # from the aux metrics; global — the layer psums them over the
+        # token shards). Counter sampled at log cadence like the rest of
+        # this window's telemetry; the static per-stage byte plan rides
+        # the ep_a2a_bytes{stage} gauges exported at trace time
+        # (parallel/expert_dispatch.export_plan_gauges).
+        routed = scalars.get("ep_tokens_routed")
+        routed_dcn = scalars.get("ep_tokens_dcn")
+        if routed is not None and routed > 0:
+            c = r.counter(
+                "ep_dispatch_tokens_total",
+                "Routed (token, slot) pairs through the expert a2a "
+                "dispatch per hierarchy stage, sampled at log cadence",
+                labelnames=("stage",),
+            )
+            c.labels(stage="ici").inc(routed)
+            if routed_dcn:
+                c.labels(stage="dcn").inc(routed_dcn)
         self.recorder.emit(
             "router_health", step=self.global_step,
             expert_load=[round(float(x), 4) for x in load],
@@ -1153,6 +1171,14 @@ class Trainer:
                 round(float(max_share), 4) if max_share is not None else None
             ),
             drop_rate=round(float(drop), 4) if drop is not None else None,
+            **(
+                {
+                    "ep_tokens_routed": round(float(routed), 1),
+                    "ep_tokens_dcn": round(float(routed_dcn or 0.0), 1),
+                }
+                if routed is not None
+                else {}
+            ),
         )
 
     # -- crash forensics (docs/observability.md "Flight recorder") --------

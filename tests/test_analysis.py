@@ -117,6 +117,15 @@ GOLDEN_FIXTURES = {
         "        labelnames=('tenant',),\n"
         "    )\n"
     ),
+    "LX010": (
+        "import jax\n"
+        "\n"
+        "def exchange(x):\n"
+        "    y = jax.lax.all_to_all(\n"
+        "        x, 'expert', split_axis=0, concat_axis=0, tiled=True\n"
+        "    )\n"
+        "    return jax.lax.ppermute(y, 'expert', [(0, 1), (1, 0)])\n"
+    ),
 }
 
 
@@ -535,6 +544,117 @@ def test_host_transfer_detector_clean_on_pure_fn():
 
     closed = jax.make_jaxpr(lambda x: (x @ x.T).sum())(jnp.ones((4, 4)))
     assert detect_host_transfers(closed) == {}
+
+
+# ---------------------------------------------------------------------------
+# comms auditor (the recompile-surface pattern, applied to collectives)
+# ---------------------------------------------------------------------------
+
+
+def test_enumerate_collectives_census_and_stage_classification():
+    """Unit contract on a hand-built shard_map body: counts, axes,
+    payload bytes, and the contiguous-vs-strided stage classifier."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from luminaai_tpu.analysis.jaxpr_audit import enumerate_collectives
+    from luminaai_tpu.parallel.mesh import all_to_all, shard_map
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("expert",))
+
+    def body(x):  # x [4, 2, 8] per shard
+        flat = all_to_all(x, "expert", split_axis=0, concat_axis=0,
+                          tiled=True)
+        r = x.reshape(2, 2, 2, 8)
+        ici = all_to_all(r, "expert", split_axis=1, concat_axis=1,
+                         tiled=True,
+                         axis_index_groups=[[0, 1], [2, 3]])
+        dcn = all_to_all(ici, "expert", split_axis=0, concat_axis=0,
+                         tiled=True,
+                         axis_index_groups=[[0, 2], [1, 3]])
+        return jax.lax.psum(
+            flat.sum() + dcn.sum(), "expert"
+        )
+
+    closed = jax.make_jaxpr(
+        shard_map(
+            body, mesh=mesh, in_specs=P("expert"), out_specs=P(),
+            check_vma=False,
+        )
+    )(jnp.ones((16, 2, 8), jnp.float32))
+    census = enumerate_collectives(closed)
+    assert census["counts"] == {"all_to_all": 3, "psum": 1}
+    stages = sorted(
+        rec["stage"] for rec in census["ops"]
+        if rec["primitive"] == "all_to_all"
+    )
+    assert stages == ["dcn", "flat", "ici"]
+    for rec in census["ops"]:
+        if rec["primitive"] == "all_to_all":
+            assert rec["payload_bytes"] == 4 * 2 * 8 * 4  # per-shard f32
+            assert rec["axes"] == ("expert",)
+
+
+def test_a2a_stage_classifier_degenerate_factorings():
+    """Review fix: with ici == 1 (one expert chip per host) the single
+    stage-2 rail is CONTIGUOUS [0..dcn-1] — it must classify as 'dcn'
+    (every byte crosses hosts), and the singleton stage-1 groups as
+    'ici'. The strided/contiguous signature alone would invert the
+    auditor's one job for that legal config."""
+    from luminaai_tpu.analysis.jaxpr_audit import _a2a_stage
+    from luminaai_tpu.parallel.expert_dispatch import hierarchical_groups
+
+    g1, g2 = hierarchical_groups(4, 4)  # ici == 1
+    assert _a2a_stage({"axis_index_groups": g1}) == "ici"
+    assert _a2a_stage({"axis_index_groups": g2}) == "dcn"
+    g1, g2 = hierarchical_groups(8, 2)  # the common shape
+    assert _a2a_stage({"axis_index_groups": g1}) == "ici"
+    assert _a2a_stage({"axis_index_groups": g2}) == "dcn"
+    assert _a2a_stage({"axis_index_groups": None}) == "flat"
+
+
+@pytest.fixture(scope="module")
+def ep_dispatch_report():
+    from luminaai_tpu.analysis.jaxpr_audit import audit_ep_dispatch
+
+    return audit_ep_dispatch()
+
+
+def test_ep_dispatch_audit_pins_collective_counts(ep_dispatch_report):
+    """Pinned collective counts for the a2a MoE layer program (ep8 =
+    dcn2 × ici4, overlap chunks 2): 1 counts exchange + 1 stage-1 +
+    chunks stage-2 dispatch + chunks stage-2 combine + 1 stage-1
+    combine = 7 all_to_alls; 3 psums (tokens_per_expert + the two
+    routed-token stats — NO full-activation psum, that's the point).
+    The replicated gmm baseline: 2 psums (full token outputs + counts).
+    A change that RAISES these means a collective slipped into the hot
+    path; one that removes the stage split breaks the dcn audit."""
+    rep = ep_dispatch_report
+    assert rep["available"], rep
+    assert rep["a2a"]["counts"] == {"all_to_all": 7, "psum": 3}
+    assert rep["replicated_gather"]["counts"] == {"psum": 2}
+    # Stage byte split exists and the flat (counts) exchange is tiny.
+    stages = rep["a2a"]["stages"]
+    assert stages["ici"] > 0 and stages["dcn"] > 0
+    assert stages["flat"] < 1024  # the int32 counts matrix
+
+
+def test_ep_dispatch_audit_dcn_bytes_strictly_below_gather(
+    ep_dispatch_report,
+):
+    """THE acceptance pin (mirrored in CI via extras.ep_dispatch): the
+    a2a path's dcn-crossing payload bytes are strictly below the
+    replicated gather's on the same mesh and routing shape."""
+    rep = ep_dispatch_report
+    assert rep["available"], rep
+    assert 0 < rep["a2a_dcn_bytes"] < rep["gather_dcn_bytes"]
+    assert rep["a2a_below_gather"] is True
+    # And the static DispatchPlan agrees with the traced direction.
+    plan = rep["plan"]
+    assert plan["a2a_dcn_bytes"] > 0
+    assert plan["a2a_dcn_bytes"] < plan["baseline_dcn_bytes"]
 
 
 # ---------------------------------------------------------------------------

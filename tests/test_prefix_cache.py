@@ -387,6 +387,212 @@ def test_harvest_device_copy_failure_leaves_no_poisoned_hits(setup):
     assert info3["prefix"]["hit_pages"] == 2
 
 
+@pytest.mark.parametrize("key", [(0.0, 0, 1.0, 1.0), (0.9, 0, 1.0, 1.0)])
+def test_cached_prefix_decode_bit_exact_int8_kv(setup, key):
+    """ROADMAP item 2 REMAINING: prefix cache × int8 KV parity. Under
+    kv_cache_dtype='int8' the pool stores quantized codes + per-page
+    scales; a harvested arena page copies BOTH leaves bit-identically,
+    so cached-vs-cold decode must stay exactly equal on ragged_xla —
+    greedy AND seeded sampling — like the bf16 pins above."""
+    tok, cfg, model, params = setup
+    icfg = dataclasses.replace(
+        cfg, attention_backend="ragged_xla", kv_cache_dtype="int8"
+    )
+    prefix = tok.encode_text(
+        "the quick brown fox jumps over the lazy dog " * 3
+    )[:96]
+    prompts = [
+        prefix + tok.encode_text(s) for s in ("alpha beta", "gamma", "z")
+    ]
+    cold = GenerationEngine(model, params, tok, icfg).make_stepwise(
+        num_slots=2, page_size=32, max_slot_tokens=192
+    )
+    cached = GenerationEngine(model, params, tok, icfg).make_stepwise(
+        num_slots=2, page_size=32, max_slot_tokens=192,
+        prefix_cache_pages=6,
+    )
+    assert cached.prefix_cache is not None
+    for i, p in enumerate(prompts):
+        want, _ = _drive(cold, p, 8, seed=11 + i, sample_key=key)
+        got, _ = _drive(cached, p, 8, seed=11 + i, sample_key=key)
+        assert got == want, ("int8", key, i)
+    st = cached.prefix_cache.stats()
+    assert st["hits"] >= 2 and st["tokens_saved"] >= 2 * 96
+
+
+# ---------------------------------------------------------------------------
+# in-flight dedup (ROADMAP item 2 REMAINING)
+# ---------------------------------------------------------------------------
+def test_pending_claim_semantics():
+    """Host-index unit contract: the first admission claims the
+    non-resident chain; followers see has_pending_prefix and park;
+    release unblocks."""
+    cache = RadixPrefixCache(list(range(100, 110)), page_size=4)
+    chain = page_chain_keys(list(range(12)), 4)
+    assert not cache.has_pending_prefix(chain)
+    own = cache.claim_pending(chain, owner=0)
+    assert own == chain
+    assert cache.has_pending_prefix(chain)
+    # A second claimant gets nothing (the leader's harvest covers it).
+    assert cache.claim_pending(chain, owner=1) == []
+    # Divergent chains are unaffected.
+    other = page_chain_keys([9] * 8, 4)
+    assert not cache.has_pending_prefix(other)
+    # Harvest lands: pages resident, pending released -> follower hits.
+    cache.insert(list(range(12)), from_page=0, tenant="a")
+    cache.release_pending(own)
+    assert not cache.has_pending_prefix(chain)
+    assert cache.pending_pages() == 0
+    ids, rows = cache.acquire(list(range(12)), keys=chain)
+    assert rows == 12
+
+
+def test_inflight_dedup_second_admission_waits_then_hits(setup):
+    """Decoder contract: two same-prefix admissions in flight — the
+    second parks behind the leader's pending-insert entry (no cold
+    prefill), resolves to a genuine HIT after the leader's harvest,
+    and decodes bit-exactly. stats: one miss (the leader), one hit
+    (the follower) — NOT two misses."""
+    tok, cfg, model, params = setup
+    engine = GenerationEngine(model, params, tok, cfg)
+    dec = engine.make_stepwise(
+        num_slots=2, page_size=32, max_slot_tokens=192,
+        prefix_cache_pages=6,
+    )
+    prefix = tok.encode_text("shared few-shot template " * 8)[:96]
+    p1 = prefix + tok.encode_text("one")
+    p2 = prefix + tok.encode_text("two")
+
+    # Cold reference for the follower's prompt.
+    ref = GenerationEngine(model, params, tok, cfg).make_stepwise(
+        num_slots=2, page_size=32, max_slot_tokens=192
+    )
+    want, _ = _drive(ref, p2, 6, seed=1)
+
+    s1, s2 = dec.acquire_slot(), dec.acquire_slot()
+    st1 = dec.start_prefill(s1, p1, max_new_tokens=6, seed=0)
+    st2 = dec.start_prefill(s2, p2, max_new_tokens=6, seed=1)
+    assert st1 is not None and st2 is not None
+    assert st2.get("waiting") is True
+    assert dec.prefix_cache.dedup_waits == 1
+    # Interleave like the scheduler: one chunk (or wait re-check) per
+    # lane per tick. The follower burns ticks, never chunk FLOPs,
+    # until the leader's final chunk harvests.
+    info1 = info2 = None
+    for _ in range(64):
+        if info1 is None:
+            info1 = dec.advance_prefill(st1)
+        if info2 is None:
+            info2 = dec.advance_prefill(st2)
+        if info1 is not None and info2 is not None:
+            break
+    assert info1 is not None and info2 is not None
+    assert info1["prefix"]["hit_pages"] == 0
+    assert info1["prefix"]["pages_harvested"] == 3
+    # The follower resolved to a real hit on the leader's pages.
+    assert info2["prefix"]["hit_pages"] == 3
+    assert info2["prefix"]["dedup_wait_ticks"] >= 1
+    st = dec.prefix_cache.stats()
+    assert st["misses"] == 1 and st["hits"] == 1
+    assert st["pending_pages"] == 0  # claims released with the harvest
+    # And the follower's decode is bit-exact vs cold.
+    out2 = [] if info2["token"] is None else [info2["token"]]
+    while dec._active[s2] and len(out2) < 6:
+        toks, produced, eos = dec.decode_step(None)
+        if eos[s2]:
+            break
+        if produced[s2]:
+            out2.append(int(toks[s2]))
+    dec.release_slot(s1)
+    dec.release_slot(s2)
+    assert out2 == want
+
+
+def test_inflight_dedup_leader_death_unparks_follower(setup):
+    """A leader evicted mid-prefill must release its pending claims so
+    the parked follower proceeds COLD instead of waiting out its
+    budget — no admission can be wedged by a dead leader."""
+    tok, cfg, model, params = setup
+    engine = GenerationEngine(model, params, tok, cfg)
+    dec = engine.make_stepwise(
+        num_slots=2, page_size=32, max_slot_tokens=192,
+        prefix_cache_pages=6,
+    )
+    prefix = tok.encode_text("doomed leader prompt " * 8)[:96]
+    s1, s2 = dec.acquire_slot(), dec.acquire_slot()
+    st1 = dec.start_prefill(
+        s1, prefix + tok.encode_text("a"), max_new_tokens=4, seed=0
+    )
+    st2 = dec.start_prefill(
+        s2, prefix + tok.encode_text("b"), max_new_tokens=4, seed=0
+    )
+    assert st2.get("waiting") is True
+    dec.release_slot(s1)  # leader dies before any harvest
+    assert dec.prefix_cache.pending_pages() == 0
+    info2 = None
+    for _ in range(16):
+        info2 = dec.advance_prefill(st2)
+        if info2 is not None:
+            break
+    assert info2 is not None
+    assert info2["prefix"]["hit_pages"] == 0  # cold, not a stale hit
+    assert info2["prefix"]["pages_harvested"] == 3  # and IT harvests
+    dec.release_slot(s2)
+
+
+def test_inflight_dedup_two_admissions_one_scheduler_tick(setup):
+    """Scheduler contract (the ISSUE's acceptance shape): two
+    same-prefix requests admitted into free slots in one scheduler
+    tick share ONE pending-insert entry — one miss, one dedup wait
+    resolving to a hit — and both streams complete correctly."""
+    import threading
+
+    from luminaai_tpu.monitoring.telemetry import MetricsRegistry
+    from luminaai_tpu.serving.server import ContinuousScheduler
+
+    tok, cfg, model, params = setup
+    engine = GenerationEngine(model, params, tok, cfg)
+    dec = engine.make_stepwise(
+        num_slots=2, page_size=32, max_slot_tokens=192,
+        prefix_cache_pages=6,
+    )
+    sched = ContinuousScheduler(
+        engine, decoder=dec, registry=MetricsRegistry()
+    )
+    prefix_text = "system: you are a helpful assistant. " * 4
+    results = {}
+    lock = threading.Lock()
+
+    def hit(name, tail):
+        out = sched.submit(
+            tok.encode_text(prefix_text + tail),
+            {"max_new_tokens": 4, "temperature": 0.0,
+             "repetition_penalty": 1.0},
+        )
+        with lock:
+            results[name] = out
+
+    threads = [
+        threading.Thread(target=hit, args=(f"r{i}", f"tail {i}"))
+        for i in range(2)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert len(results) == 2
+    st = dec.prefix_cache.stats()
+    # One of the two cold-started (miss + harvest); the other either
+    # parked behind the pending entry (dedup_waits) or — if the races
+    # landed it after the harvest — hit outright. Never two misses.
+    assert st["misses"] == 1, st
+    assert st["hits"] == 1, st
+    assert st["pending_pages"] == 0, st
+    for out in results.values():
+        toks = out[0] if isinstance(out, tuple) else out
+        assert isinstance(toks, list) and len(toks) >= 1
+
+
 def test_short_cold_prompts_do_not_skew_miss_counts(setup):
     """Review fix: a short prompt that falls back to the monolithic
     prefill path must not book a cache miss — cache.stats() and the
