@@ -501,6 +501,20 @@ def _child_main(name: str) -> None:
                 "available": False,
                 "reason": "child budget exhausted before ep-dispatch audit",
             }
+        # Hierarchical gradient reduction (ROADMAP item 3's other
+        # cross-host hot path): the comms auditor's hierarchical-vs-flat
+        # DCN byte comparison for the fsdp/dp gradient sync on the same
+        # simulated dcn2 mesh (subprocess with 8 virtual CPU devices).
+        # CI asserts the hierarchical sync's DCN-crossing bytes strictly
+        # below the flat GSPMD baseline's (docs/parallelism.md
+        # "Hierarchical gradient reduction"). Budget-guarded as above.
+        if not budget or time.perf_counter() - child_t0 < 0.85 * budget:
+            ex["grad_reduce"] = _smoke_grad_reduce()
+        else:
+            ex["grad_reduce"] = {
+                "available": False,
+                "reason": "child budget exhausted before grad-reduce audit",
+            }
         from luminaai_tpu.training.optimizer import describe_optimizer_memory
 
         ex["optimizer_memory"] = describe_optimizer_memory(state.opt_state)
@@ -902,6 +916,85 @@ def _serve_bench_main(smoke: bool) -> None:
                 result["error"] = "prefix_cache_prefill_not_faster"
             elif not prefix_cache["hit_rate"] > 0:
                 result["error"] = "prefix_cache_no_hits"
+
+        # -- int8 KV-cache tier (ROADMAP item 4: the serving default) --
+        # The documented serving config stores the paged KV pool as int8
+        # codes + per-row scales (half the cache HBM, so max concurrent
+        # lanes per chip roughly doubles — docs/quantization.md). This
+        # tier runs the same greedy workload through the stepwise
+        # serving path under kv_cache_dtype='int8' and asserts the
+        # serving-path contract: stepwise streams EXACTLY reproduce
+        # generate() under the same int8 config (greedy parity — the
+        # PR 1 framing, now pinned for the quantized default too).
+        import time as _time
+
+        def _kv_tier(kv_dtype):
+            kcfg = _dc.replace(cfg, kv_cache_dtype=kv_dtype)
+            keng = GenerationEngine(model, params, _Tok(), kcfg)
+            kp = prompts[:4]
+            kb = [12] * len(kp)
+            refs = [
+                keng.generate(
+                    p, max_new_tokens=b, temperature=0.0, seed=0,
+                    repetition_penalty=1.0,
+                )[0]
+                for p, b in zip(kp, kb)
+            ]
+            dec = keng.make_stepwise(num_slots=4, page_size=64)
+            outs, slots = {}, {}
+            t0 = _time.perf_counter()
+            for i, (p, b) in enumerate(zip(kp, kb)):
+                s = dec.acquire_slot()
+                slots[i] = s
+                info = dec.prefill_into_slot(
+                    s, p, max_new_tokens=b, seed=0
+                )
+                outs[i] = [] if info["token"] is None else [info["token"]]
+            done = {i for i in outs if not dec._active[slots[i]]}
+            for _ in range(64):
+                if len(done) == len(kp):
+                    break
+                toks, produced, eos = dec.decode_step()
+                for i in set(range(len(kp))) - done:
+                    s = slots[i]
+                    if eos[s]:
+                        done.add(i)
+                        dec.release_slot(s)
+                    elif produced[s]:
+                        outs[i].append(int(toks[s]))
+                        if len(outs[i]) >= kb[i]:
+                            done.add(i)
+                            dec.release_slot(s)
+            wall = _time.perf_counter() - t0
+            streams = [outs[i] for i in range(len(kp))]
+            n_tok = sum(len(s) for s in streams)
+            pool_bytes = sum(
+                l.nbytes for l in jax.tree_util.tree_leaves(
+                    dec.pool.caches
+                )
+            )
+            return streams, refs, n_tok / max(wall, 1e-9), pool_bytes
+
+        i8_streams, i8_refs, i8_tps, i8_bytes = _kv_tier("int8")
+        bf_streams, bf_refs, bf_tps, bf_bytes = _kv_tier("bf16")
+        kv_int8 = {
+            "default_documented": "int8",
+            "greedy_parity": bool(i8_streams == i8_refs),
+            "bf16_greedy_parity": bool(bf_streams == bf_refs),
+            "tokens_per_sec_int8": round(i8_tps, 1),
+            "tokens_per_sec_bf16": round(bf_tps, 1),
+            "pool_bytes_int8": i8_bytes,
+            "pool_bytes_bf16": bf_bytes,
+            # codes+scales vs bf16 rows: < 1.0 is the HBM halving claim
+            "pool_bytes_ratio": (
+                round(i8_bytes / bf_bytes, 4) if bf_bytes else None
+            ),
+        }
+        if "error" not in result:
+            if not kv_int8["greedy_parity"]:
+                result["error"] = "int8_kv_greedy_parity_broken"
+            elif not i8_bytes < bf_bytes:
+                result["error"] = "int8_kv_pool_not_smaller"
         result.update(
             value=round(cont_tps, 1),
             # Baseline for THIS metric is the legacy micro-batched path
@@ -940,6 +1033,10 @@ def _serve_bench_main(smoke: bool) -> None:
                 # asserts hit_rate > 0, tokens_saved >= 0.5x prompt
                 # tokens, and strictly lower summed prefill seconds).
                 "prefix_cache": prefix_cache,
+                # int8 KV serving tier (the documented default config):
+                # stepwise==generate greedy parity under int8 + the
+                # pool-bytes halving (CI asserts both).
+                "kv_int8": kv_int8,
                 # Registry snapshot: TTFT / per-token / queue-wait
                 # histograms and KV-pool occupancy, embedded so the
                 # serving perf claim carries its own telemetry
@@ -1567,6 +1664,50 @@ def _smoke_ep_dispatch() -> dict:
         "import json\n"
         "from luminaai_tpu.analysis.jaxpr_audit import audit_ep_dispatch\n"
         "print(json.dumps(audit_ep_dispatch()))\n"
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=300,
+            env=env,
+            cwd=_HERE,
+        )
+        if proc.returncode != 0:
+            err = (proc.stderr or "").strip().splitlines()
+            return {
+                "available": False,
+                "reason": (
+                    f"audit subprocess rc={proc.returncode}: "
+                    f"{err[-1][-300:] if err else 'no stderr'}"
+                ),
+            }
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except subprocess.TimeoutExpired:
+        return {"available": False, "reason": "audit subprocess timeout"}
+    except Exception as e:
+        return {"available": False, "reason": f"{type(e).__name__}: {e}"}
+
+
+def _smoke_grad_reduce() -> dict:
+    """Gradient-reduction comms audit for the smoke artifact (--smoke
+    only): analysis/jaxpr_audit.audit_grad_reduce traces the train step
+    under grad_reduce flat vs hierarchical (grad accumulation off AND
+    on) on a simulated dcn2×ici4 data mesh and prices each path's
+    DCN-crossing gradient bytes. Runs in a SUBPROCESS with 8 virtual
+    CPU devices like _smoke_ep_dispatch — abstract traces only, nothing
+    executes in the child either."""
+    code = (
+        "import json\n"
+        "from luminaai_tpu.analysis.jaxpr_audit import audit_grad_reduce\n"
+        "print(json.dumps(audit_grad_reduce()))\n"
     )
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"

@@ -1206,6 +1206,17 @@ class StepwiseDecoder:
         # dies before its harvest, or followers park until their wait
         # budget expires).
         self._pending_claims: Dict[int, List[str]] = {}
+        # Deferred harvest queue: (src global id, dst arena id) page
+        # copies registered by _harvest but not yet executed on device.
+        # flush_harvests() coalesces EVERYTHING queued into one jitted
+        # bulk copy — the scheduler flushes once per tick, so N
+        # admissions finishing in one tick cost one dispatch, not N
+        # (ROADMAP item 2 harvest batching). Queued dst pages are
+        # refcount-pinned; _arm_prefill flushes before any acquire so a
+        # hit can never splice a page whose bytes have not landed.
+        self._harvest_queue: List[Tuple[int, int]] = []
+        self.harvest_copy_calls = 0
+        self.harvest_flushes = 0
         self._refresh_table()
 
     def _refresh_table(self) -> None:
@@ -1230,6 +1241,10 @@ class StepwiseDecoder:
     def acquire_slot(self) -> int:
         slot = self.pool.alloc()
         if self.prefix_cache is not None:
+            # A queued harvest may source from a slot being recycled:
+            # its pages must land in the arena before the new occupant
+            # writes over them.
+            self.flush_harvests()
             # Fresh occupants start from identity; a prefix splice
             # retargets entries AFTER acquire, never across realloc.
             self._reset_gtable_row(slot)
@@ -1673,6 +1688,10 @@ class StepwiseDecoder:
         hit_ids: List[int] = []
         hit_rows = 0
         if self.prefix_cache is not None:
+            # Any queued harvest must land before this admission can
+            # acquire: a hit on a freshly-inserted page whose copy has
+            # not flushed would splice unwritten arena K/V.
+            self.flush_harvests()
             chain = st["chain"]
             # Pin before splicing: an acquired page cannot be evicted
             # until release_slot drops the lease. (Counts the hit/miss.)
@@ -1790,9 +1809,13 @@ class StepwiseDecoder:
 
     def _harvest(self, slot: int, st: Dict[str, Any]) -> int:
         """Register this prompt's freshly-computed full pages in the
-        prefix cache and copy their K/V from the lane's slot into the
-        arena (the one-time cost future admissions amortize away).
-        Returns the number of pages harvested."""
+        prefix cache and QUEUE their K/V copy from the lane's slot into
+        the arena (the one-time cost future admissions amortize away).
+        The device copy itself is deferred to flush_harvests() so every
+        harvest landing in one scheduler tick rides ONE jitted bulk
+        copy instead of one dispatch per admission. Queued dst pages
+        are pinned (a later insert's eviction pressure cannot reassign
+        them mid-queue). Returns the number of pages queued."""
         assignments = self.prefix_cache.insert(
             st["prompt"], from_page=int(st.get("p0", 0)),
             tenant=st.get("tenant", "anon"),
@@ -1800,8 +1823,25 @@ class StepwiseDecoder:
         if not assignments:
             return 0
         P = self.pool.pages
-        src = [slot * P + j for j, _ in assignments]
-        dst = [pid for _, pid in assignments]
+        self.prefix_cache.pin_pages([pid for _, pid in assignments])
+        self._harvest_queue.extend(
+            (slot * P + j, pid) for j, pid in assignments
+        )
+        return len(assignments)
+
+    def flush_harvests(self) -> int:
+        """Execute every queued harvest as ONE jitted bulk page copy
+        (pow2-padded pair count, same executable ladder as before).
+        Called by the scheduler once per tick, and defensively before
+        any cache acquire / slot realloc (see _harvest). Returns pages
+        flushed; on copy failure the queued inserts are forgotten so
+        the index never points at unwritten arena pages."""
+        if not self._harvest_queue:
+            return 0
+        pairs, self._harvest_queue = self._harvest_queue, []
+        src = [s for s, _ in pairs]
+        dst = [d for _, d in pairs]
+        self.harvest_flushes += 1
         K = 1
         while K < len(src):
             K *= 2
@@ -1810,6 +1850,7 @@ class StepwiseDecoder:
         src += [0] * (K - len(src))
         dst += [0] * (K - len(dst))
         try:
+            self.harvest_copy_calls += 1
             self.pool.caches = self._get_copy_pages(K)(
                 self.pool.caches,
                 jnp.asarray(src, jnp.int32),
@@ -1819,14 +1860,16 @@ class StepwiseDecoder:
             # The index must never point at arena pages that were not
             # actually written — a later hit would splice uninitialized
             # K/V. Unwind and keep serving: harvest is an optimization,
-            # the lane's own prefill already succeeded.
+            # the lanes' own prefills already succeeded.
             logger.exception(
                 "prefix-cache harvest copy failed; unwinding %d page(s)",
-                len(assignments),
+                len(pairs),
             )
-            self.prefix_cache.forget([pid for _, pid in assignments])
+            self.prefix_cache.release([d for _, d in pairs])
+            self.prefix_cache.forget([d for _, d in pairs])
             return 0
-        return len(assignments)
+        self.prefix_cache.release([d for _, d in pairs])
+        return len(pairs)
 
     def _get_copy_pages(self, K: int):
         """Jitted bulk page copy: K (src, dst) GLOBAL page id pairs moved

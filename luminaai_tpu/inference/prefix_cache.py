@@ -205,6 +205,20 @@ class RadixPrefixCache:
                 if ent is not None and ent.refs > 0:
                     ent.refs -= 1
 
+    def pin_pages(self, page_ids: Sequence[int]) -> None:
+        """Refcount-pin pages by arena id (release() unpins). Used by
+        the decoder's deferred harvest queue: a freshly-inserted page
+        whose device copy has not flushed yet must not be LRU-evicted
+        (and its arena slot reassigned) by a later insert's pressure."""
+        if not page_ids:
+            return
+        with self._lock:
+            for pid in page_ids:
+                key = self._by_page.get(int(pid))
+                ent = self._index.get(key) if key is not None else None
+                if ent is not None:
+                    ent.refs += 1
+
     # -- insert / evict ----------------------------------------------------
     def _evictable(self, tenant: Optional[str] = None) -> List[_CachedPage]:
         ents = [

@@ -961,6 +961,14 @@ class ContinuousScheduler:
             else:
                 self._pending.append(nxt)
 
+    def _flush_harvests(self) -> None:
+        """One bulk device copy for every harvest queued this tick
+        (StepwiseDecoder.flush_harvests; no-op without a prefix cache
+        or an empty queue)."""
+        flush = getattr(self.decoder, "flush_harvests", None)
+        if flush is not None:
+            flush()
+
     def _advance_prefills(self, active: dict) -> None:
         """Advance ONE chunk of ONE mid-prefill admission (round-robin
         in admission order). Called once per scheduler tick, so prefill
@@ -1094,6 +1102,10 @@ class ContinuousScheduler:
             # without ever costing the decode batch more than one
             # chunk-sized forward between steps.
             self._advance_prefills(active)
+            # Harvest batching (ROADMAP item 2): every prefix-cache
+            # harvest that landed this tick rides ONE jitted bulk page
+            # copy instead of one pool-copy dispatch per admission.
+            self._flush_harvests()
             if not active:
                 if self._prefilling:
                     continue
@@ -1159,6 +1171,9 @@ class ContinuousScheduler:
                     ):
                         self._finish(r, "length")
                         self._release(r, active)
+        # A harvest landing on the generation's last tick must not wait
+        # for the next admission's defensive flush.
+        self._flush_harvests()
 
 
 class _SlotStream:

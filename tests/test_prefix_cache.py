@@ -377,7 +377,11 @@ def test_harvest_device_copy_failure_leaves_no_poisoned_hits(setup):
     dec._get_copy_pages = boom
     prefix = tok.encode_text("system prompt " * 10)[:64]
     out, info = _drive(dec, prefix + tok.encode_text("one"), 3)
-    assert info["prefix"]["pages_harvested"] == 0  # unwound, not cached
+    # Harvests are QUEUED at admission end (deferred bulk copy) and the
+    # injected failure surfaces at flush: the unwind must leave the
+    # index clean so a later lookup can never splice unwritten pages.
+    assert info["prefix"]["pages_harvested"] == 2  # queued
+    assert dec.flush_harvests() == 0  # injected failure -> unwound
     assert dec.prefix_cache.pages_cached() == 0
     dec._get_copy_pages = real
     out2, info2 = _drive(dec, prefix + tok.encode_text("two"), 3)
@@ -385,6 +389,63 @@ def test_harvest_device_copy_failure_leaves_no_poisoned_hits(setup):
     assert info2["prefix"]["pages_harvested"] == 2  # healthy again
     out3, info3 = _drive(dec, prefix + tok.encode_text("three"), 3)
     assert info3["prefix"]["hit_pages"] == 2
+
+
+def test_harvest_batching_one_bulk_copy_per_tick(setup):
+    """ROADMAP item 2 REMAINING (harvest batching): every harvest that
+    lands between flushes coalesces into ONE jitted bulk page copy —
+    the call count is the contract. Three distinct cold admissions
+    finish in the same 'tick' (no intervening acquire), one
+    flush_harvests() runs one copy call, and the flushed pages serve
+    later admissions as genuine bit-exact hits."""
+    tok, cfg, model, params = setup
+    engine = GenerationEngine(model, params, tok, cfg)
+    dec = engine.make_stepwise(
+        num_slots=4, page_size=32, max_slot_tokens=192,
+        prefix_cache_pages=8,
+    )
+    prompts = [
+        tok.encode_text(f"distinct system prompt number {i} " * 6)[:70]
+        for i in range(3)
+    ]
+    # Admit all three FIRST (the defensive flush at admission sees an
+    # empty queue), then advance interleaved — the scheduler-tick shape.
+    slots, sts = [], []
+    for p in prompts:
+        s = dec.acquire_slot()
+        st = dec.start_prefill(s, p, max_new_tokens=4, seed=0)
+        assert st is not None
+        slots.append(s)
+        sts.append(st)
+    infos = [None] * 3
+    while any(i is None for i in infos):
+        for j, st in enumerate(sts):
+            if infos[j] is None:
+                infos[j] = dec.advance_prefill(st)
+    # All three harvests queued, ZERO device copies dispatched yet.
+    assert [i["prefix"]["pages_harvested"] for i in infos] == [2, 2, 2]
+    assert dec.harvest_copy_calls == 0
+    assert dec.flush_harvests() == 6
+    assert dec.harvest_copy_calls == 1  # the pinned call count
+    assert dec.flush_harvests() == 0  # idempotent on an empty queue
+    assert dec.harvest_copy_calls == 1
+    greedy_cold = []
+    for j, s in enumerate(slots):
+        out = [infos[j]["token"]]
+        while dec._active[s] and len(out) < 4:
+            toks, produced, eos = dec.decode_step()
+            if eos[s]:
+                break
+            if produced[s]:
+                out.append(int(toks[s]))
+        greedy_cold.append(out)
+        dec.release_slot(s)
+    # The flushed pages are REAL: re-admissions hit and decode the
+    # exact cold streams.
+    for j, p in enumerate(prompts):
+        out, info = _drive(dec, p, 4)
+        assert info["prefix"]["hit_pages"] == 2, info
+        assert out == greedy_cold[j], j
 
 
 @pytest.mark.parametrize("key", [(0.0, 0, 1.0, 1.0), (0.9, 0, 1.0, 1.0)])
