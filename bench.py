@@ -531,6 +531,14 @@ def _child_main(name: str) -> None:
         ex["resumed_exact_data_state"] = resume_check.pop(
             "resumed_exact_data_state"
         )
+        # Goodput (docs/observability.md "Goodput & sentinels"): the
+        # resumed trainer's wall-clock ledger — productive fraction plus
+        # the full cause partition (compile / checkpoint / data_wait /
+        # resume_replay / ...), sum == elapsed by construction.
+        ex["goodput"] = resume_check.pop("goodput", None) or {
+            "available": False,
+            "reason": "resume check did not produce a ledger",
+        }
         ex["resume_check"] = resume_check
         ex["bench_gate"] = _gate_verdict(result)
         # Wide-event spine (monitoring/events.py): the bench window
@@ -1520,6 +1528,13 @@ def _smoke_resume_check() -> dict:
             "preempted_at": s1.get("final_step"),
             "resumed_at": resumed_at,
             "final_step": s2.get("final_step"),
+            # Goodput ledger snapshot from the RESUMED run — the cycle
+            # that exercises every cause that needs a fault to appear:
+            # checkpoint restore, resume replay, emergency save
+            # (docs/observability.md "Goodput & sentinels"). Lifted into
+            # extras.goodput; CI asserts fraction in (0, 1] and the
+            # cause partition complete.
+            "goodput": s2.get("goodput"),
         }
     except Exception as e:  # the artifact must stay parseable
         return {

@@ -61,6 +61,9 @@ def _apply_overrides(cfg, args) -> None:
         ("moe_dispatch", "moe_dispatch"),
         ("attention_window", "attention_window"),
         ("profile_dir", "profile_dir"),
+        ("watchdog", "watchdog"),
+        ("watchdog_k", "watchdog_k"),
+        ("watchdog_floor", "watchdog_floor_s"),
     ]:
         val = getattr(args, flag, None)
         if val is not None:
@@ -84,6 +87,8 @@ def _apply_overrides(cfg, args) -> None:
             cfg.profile_start_step = 3
     if getattr(args, "cost_analysis", False):
         cfg.compiled_cost_analysis = True
+    if getattr(args, "watchdog_abort", False):
+        cfg.watchdog_abort = True
     # Axis-implied settings (ring attention under sp, scan_layers and the
     # grad-accum fold under pp) — one shared code path on Config.
     cfg.normalize_parallelism()
@@ -690,6 +695,10 @@ def cmd_serve(args) -> int:
         ),
         tenant_rate_per_s=getattr(args, "tenant_rate_per_s", None),
         tenant_burst=getattr(args, "tenant_burst", None),
+        watchdog=not getattr(args, "no_watchdog", False),
+        watchdog_abort=getattr(args, "watchdog_abort", False),
+        watchdog_k=getattr(args, "watchdog_k", None),
+        watchdog_floor_s=getattr(args, "watchdog_floor", None),
     )
     return 0
 
@@ -1127,13 +1136,17 @@ def cmd_events(args) -> int:
     (the newest flightrec-*.jsonl inside each — checkpoint dirs are the
     usual argument), or — with no paths — this process's live ring
     buffer (mostly useful in-process / in tests). Filters: --type,
-    --grep (regex over the serialized record), --tail N. --json prints
-    one JSON record per line for piping into jq."""
+    --grep (regex over the serialized record), --since (epoch ts or
+    s/m/h/d duration ago), --tail N. --stats summarizes the filtered
+    set (count/rate per type, first/last ts) instead of listing.
+    --json prints one JSON record per line for piping into jq."""
     from luminaai_tpu.monitoring.events import (
+        events_stats,
         filter_events,
         format_event,
         get_recorder,
         latest_dump,
+        parse_since,
         read_events,
     )
 
@@ -1144,6 +1157,13 @@ def cmd_events(args) -> int:
             re.compile(args.grep)
         except re.error as e:
             print(f"bad --grep regex {args.grep!r}: {e}", file=sys.stderr)
+            return 2
+    since = None
+    if getattr(args, "since", None):
+        try:
+            since = parse_since(args.since)
+        except ValueError as e:
+            print(f"bad --since value {args.since!r}: {e}", file=sys.stderr)
             return 2
 
     events: List[Dict[str, Any]] = []
@@ -1169,9 +1189,43 @@ def cmd_events(args) -> int:
     events = filter_events(
         events, type=args.etype, grep=args.grep,
         request=getattr(args, "request_id", None),
+        since=since,
         tail=args.tail if args.tail else None,
     )
-    if args.json:
+    if getattr(args, "stats", False):
+        stats = events_stats(events)
+        if args.json:
+            print(json.dumps(stats, default=str))
+        else:
+            import time as _time
+
+            def _fmt_ts(ts):
+                if not isinstance(ts, (int, float)):
+                    return "?"
+                return _time.strftime(
+                    "%Y-%m-%d %H:%M:%S", _time.localtime(ts)
+                )
+
+            print(
+                f"{stats['total']} event(s) spanning "
+                f"{stats['span_s']}s ({_fmt_ts(stats['first_ts'])} .. "
+                f"{_fmt_ts(stats['last_ts'])})"
+            )
+            header = f"{'type':<24}{'count':>8}{'rate/s':>10}  first .. last"
+            print(header)
+            print("-" * len(header))
+            for t, rec in stats["by_type"].items():
+                rate = (
+                    f"{rec['rate_per_s']:.3f}"
+                    if rec["rate_per_s"] is not None
+                    else "-"
+                )
+                print(
+                    f"{t:<24}{rec['count']:>8}{rate:>10}  "
+                    f"{_fmt_ts(rec['first_ts'])} .. "
+                    f"{_fmt_ts(rec['last_ts'])}"
+                )
+    elif args.json:
         for ev in events:
             print(json.dumps(ev, default=str))
     else:
@@ -1322,6 +1376,32 @@ def build_parser() -> argparse.ArgumentParser:
             "--cost-analysis", dest="cost_analysis", action="store_true",
             help="export XLA compiled-cost gauges (flops/bytes/HBM) and "
                  "the analytic-vs-compiled MFU cross-check at first compile",
+        )
+        wd = sp.add_argument_group(
+            "hang watchdog (docs/observability.md 'Goodput & sentinels')"
+        )
+        wd.add_argument(
+            "--watchdog", dest="watchdog",
+            action=argparse.BooleanOptionalAction, default=None,
+            help="heartbeat hang detection over the train loop "
+                 "(default: on; fires hang_suspected + stack/ring dumps "
+                 "when a step window exceeds k x rolling median)",
+        )
+        wd.add_argument(
+            "--watchdog-abort", dest="watchdog_abort", action="store_true",
+            help="exit 75 (resumable) after a confirmed hang is dumped, "
+                 "so the orchestrator restarts instead of burning the "
+                 "reservation",
+        )
+        wd.add_argument(
+            "--watchdog-k", dest="watchdog_k", type=float,
+            help="robust threshold multiplier over the rolling median "
+                 "step window (default 10)",
+        )
+        wd.add_argument(
+            "--watchdog-floor", dest="watchdog_floor", type=float,
+            help="minimum stall seconds before the watchdog can fire "
+                 "(default 30)",
         )
         par = sp.add_argument_group("parallelism (docs/parallelism.md)")
         par.add_argument("--dp", type=int, help="data axis (-1 = auto)")
@@ -1496,6 +1576,26 @@ def build_parser() -> argparse.ArgumentParser:
                     default=None,
                     help="per-tenant token-bucket burst capacity "
                          "(default: ~1s of --tenant-rate)")
+    sv.add_argument("--no-watchdog", dest="no_watchdog",
+                    action="store_true",
+                    help="disable the decode-loop hang watchdog "
+                         "(hang_suspected events + stack/ring dumps on a "
+                         "stuck decode step)")
+    sv.add_argument("--watchdog-abort", dest="watchdog_abort",
+                    action="store_true",
+                    help="exit 75 (resumable) after a confirmed decode "
+                         "hang is dumped, so the orchestrator restarts "
+                         "the replica")
+    sv.add_argument("--watchdog-k", dest="watchdog_k", type=float,
+                    default=None,
+                    help="robust threshold multiplier over the rolling "
+                         "median decode step (default 10)")
+    sv.add_argument("--watchdog-floor", dest="watchdog_floor", type=float,
+                    default=None,
+                    help="minimum stall seconds before the serving "
+                         "watchdog can fire (default 30; raise above "
+                         "your worst-case decode compile before "
+                         "enabling --watchdog-abort)")
     sv.set_defaults(fn=cmd_serve)
 
     b = sub.add_parser("benchmark", help="run the bench harness")
@@ -1590,8 +1690,17 @@ def build_parser() -> argparse.ArgumentParser:
                          "lifecycle (admission -> prefix_hit -> chunks "
                          "-> completion) — the cache-splice debugging "
                          "loop")
+    ev.add_argument("--since", dest="since",
+                    help="only events at/after this floor: an epoch "
+                         "timestamp, or a duration ago with an s/m/h/d "
+                         "suffix (e.g. 90s, 5m, 2h)")
+    ev.add_argument("--stats", action="store_true",
+                    help="summarize instead of listing: count + rate per "
+                         "event type, first/last timestamps (applies "
+                         "after the other filters)")
     ev.add_argument("--json", action="store_true",
-                    help="one JSON record per line (pipe into jq)")
+                    help="one JSON record per line (pipe into jq); with "
+                         "--stats, the summary as one JSON object")
     ev.set_defaults(fn=cmd_events)
 
     s = sub.add_parser("presets", help="list model presets")

@@ -340,6 +340,30 @@ class Config:
     loss_spike_threshold: float = 2.0
     grad_norm_threshold: float = 100.0
     expert_collapse_threshold: float = 0.05
+    # Goodput ledger + hang watchdog + step-time anomaly sentinel
+    # (docs/observability.md "Goodput & sentinels"). The ledger
+    # attributes every second of the run to a cause and exports
+    # training_goodput_fraction; the watchdog heartbeats at the
+    # log-window sync and fires when a beat gap exceeds
+    # watchdog_k x (rolling median + MAD), floored at watchdog_floor_s
+    # — warmup-aware, so the first compile can never trip it. All
+    # host-side wall clock: zero new syncs on the step path.
+    goodput: bool = True
+    watchdog: bool = True
+    watchdog_k: float = 10.0
+    watchdog_floor_s: float = 30.0
+    watchdog_warmup: int = 3
+    watchdog_poll_s: float = 1.0
+    # Opt-in (--watchdog-abort): a confirmed stall exits 75 (resumable)
+    # after dumping stacks + the flight ring, so orchestrators restart
+    # the job instead of burning the reservation on a wedged sync.
+    watchdog_abort: bool = False
+    # Step-time anomaly sentinel: a logged window mean flagged when it
+    # exceeds step_anomaly_k x rolling median (+ MAD significance
+    # guard). step_anomaly=False silences a known-noisy workload
+    # (no gauges, no events).
+    step_anomaly: bool = True
+    step_anomaly_k: float = 4.0
 
     # --- Adaptive control (orchestrator) ---
     enable_adaptive_lr: bool = True
@@ -488,6 +512,11 @@ class Config:
         assert self.lr_scheduler in LR_SCHEDULES, (
             f"invalid lr_scheduler {self.lr_scheduler}"
         )
+        assert self.watchdog_k > 0, "watchdog_k must be positive"
+        assert self.watchdog_floor_s > 0, "watchdog_floor_s must be positive"
+        assert self.watchdog_warmup >= 1, "watchdog_warmup must be >= 1"
+        assert self.watchdog_poll_s > 0, "watchdog_poll_s must be positive"
+        assert self.step_anomaly_k > 1, "step_anomaly_k must be > 1"
         if self.use_moe:
             assert self.moe_top_k <= self.num_experts, "moe_top_k must be <= num_experts"
             assert self.moe_pattern in MOE_PATTERNS, (

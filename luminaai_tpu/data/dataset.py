@@ -23,6 +23,7 @@ import logging
 import os
 import queue
 import threading
+import time
 from pathlib import Path
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
 
@@ -596,6 +597,11 @@ class PrefetchLoader:
         self._consuming = 0  # epoch the current/most recent iterator serves
         self._yielded = 0  # batches yielded to the consumer this epoch
         self._resume_skip = 0
+        # Wall clock burned replaying (skipping) already-trained batches
+        # after a resume — the goodput ledger's `resume_replay` cause.
+        # Accumulates across epochs; the trainer drains it via
+        # consume_resume_replay_seconds() (docs/observability.md).
+        self._resume_replay_s = 0.0
         import inspect
 
         try:
@@ -614,6 +620,14 @@ class PrefetchLoader:
             target(difficulty)
             return True
         return False
+
+    def consume_resume_replay_seconds(self) -> float:
+        """Drain the wall clock spent fast-forwarding past resumed
+        batches since the last call (0.0 when no resume replay ran).
+        The trainer reattributes it from data_wait to resume_replay in
+        the goodput ledger."""
+        s, self._resume_replay_s = self._resume_replay_s, 0.0
+        return s
 
     # -- exact-resume state (docs/resilience.md) -------------------------
     def state_dict(self) -> Dict[str, Any]:
@@ -695,6 +709,19 @@ class PrefetchLoader:
 
         t = threading.Thread(target=worker, daemon=True)
         t.start()
+        t_replay0 = time.perf_counter() if skip > 0 else None
+
+        def _bank_replay():
+            # Bank the replay wall clock for the goodput ledger's
+            # resume_replay cause — on the normal skip-exhausted
+            # transition AND from the finally, so an epoch ending (or
+            # the consumer abandoning the iterator) mid-replay doesn't
+            # silently leave the time misattributed as data_wait.
+            nonlocal t_replay0
+            if t_replay0 is not None:
+                self._resume_replay_s += time.perf_counter() - t_replay0
+                t_replay0 = None
+
         try:
             while True:
                 item = q.get()
@@ -704,6 +731,8 @@ class PrefetchLoader:
                     # Resume fast-forward: these batches were consumed by
                     # the interrupted run before its checkpoint landed.
                     skip -= 1
+                    if skip == 0:
+                        _bank_replay()
                     continue
                 self._yielded += 1
                 yield item
@@ -713,6 +742,7 @@ class PrefetchLoader:
             self._consuming = self._epoch
             self._yielded = 0
         finally:
+            _bank_replay()  # epoch ended / consumer gone mid-replay
             stop.set()
             t.join(timeout=5.0)
 

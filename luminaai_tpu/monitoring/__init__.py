@@ -17,6 +17,13 @@ from luminaai_tpu.monitoring.events import (
     get_recorder,
     set_recorder,
 )
+from luminaai_tpu.monitoring.goodput import CAUSES, GoodputLedger
+from luminaai_tpu.monitoring.watchdog import (
+    HangWatchdog,
+    RobustStats,
+    StepTimeSentinel,
+    host_step_skew,
+)
 from luminaai_tpu.monitoring.logger import (
     MetricsCollector,
     TrainingAlert,
@@ -34,6 +41,12 @@ __all__ = [
     "FlightRecorder",
     "get_recorder",
     "set_recorder",
+    "CAUSES",
+    "GoodputLedger",
+    "HangWatchdog",
+    "RobustStats",
+    "StepTimeSentinel",
+    "host_step_skew",
     "MetricsCollector",
     "TrainingAlert",
     "TrainingHealthMonitor",

@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import os
 import re
 import threading
@@ -58,6 +59,8 @@ __all__ = [
     "latest_dump",
     "filter_events",
     "format_event",
+    "events_stats",
+    "parse_since",
     "DUMP_PREFIX",
 ]
 
@@ -140,6 +143,16 @@ class FlightRecorder:
     def __len__(self) -> int:
         with self._lock:
             return len(self._buf)
+
+    def __bool__(self) -> bool:
+        """Always True: a recorder's identity is what matters, never its
+        fill level. Without this, defining __len__ made an EMPTY recorder
+        falsy — so the natural `recorder or get_recorder()` idiom
+        silently swapped a caller's explicit (empty) recorder for the
+        process default. Every producer uses `is None` checks, and this
+        makes the or-idiom safe too (regression-pinned in
+        tests/test_events.py)."""
+        return True
 
     def clear(self) -> None:
         """Tests only: empty the ring (seq/counts keep counting)."""
@@ -225,16 +238,23 @@ def filter_events(
     grep: Optional[str] = None,
     tail: Optional[int] = None,
     request: Optional[str] = None,
+    since: Optional[float] = None,
 ) -> List[Dict[str, Any]]:
     """Shared query semantics for the CLI and tests: type match, one
     request's lifecycle (`lumina events --request <id>`: admission →
     prefix_hit → chunks → completion), regex over the serialized
-    record, then last-N."""
+    record, time floor (`--since`, epoch seconds — events without a
+    numeric ts are dropped by the filter), then last-N."""
     out = list(events)
     if type:
         out = [e for e in out if e.get("type") == type]
     if request:
         out = [e for e in out if e.get("request_id") == request]
+    if since is not None:
+        out = [
+            e for e in out
+            if isinstance(e.get("ts"), (int, float)) and e["ts"] >= since
+        ]
     if grep:
         rx = re.compile(grep)
         out = [
@@ -243,6 +263,72 @@ def filter_events(
     if tail is not None and tail > 0:
         out = out[-tail:]
     return out
+
+
+_SINCE_UNITS = {"s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}
+
+
+def parse_since(spec: str, now: Optional[float] = None) -> float:
+    """`lumina events --since <ts|dur>` → an epoch-seconds floor.
+
+    A trailing s/m/h/d makes it a duration ago ("90s", "5m", "2h",
+    "1d"); a bare number is an absolute epoch timestamp (what the
+    records themselves carry). Raises ValueError on anything else —
+    the CLI maps that to exit 2 like a bad --grep regex."""
+    spec = (spec or "").strip()
+    if not spec:
+        raise ValueError("empty --since value")
+    unit = _SINCE_UNITS.get(spec[-1].lower())
+    if unit is not None:
+        dur = float(spec[:-1]) * unit  # ValueError propagates on junk
+        if not math.isfinite(dur) or dur < 0:
+            raise ValueError(f"bad --since duration {spec!r}")
+        return (now if now is not None else time.time()) - dur
+    ts = float(spec)
+    if not math.isfinite(ts):
+        # float() accepts "nan"/"inf"; a NaN floor would silently filter
+        # EVERY event (exit 0, empty output) instead of rejecting the
+        # input — the exit-2 contract must catch it here.
+        raise ValueError(f"non-finite --since timestamp {spec!r}")
+    return ts
+
+
+def events_stats(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """`lumina events --stats`: per-type counts and rates plus the
+    first/last timestamps — a dump or live ring summarized without
+    scrolling it. Rates use the OVERALL observed span (last - first ts)
+    so per-type numbers are comparable on one denominator."""
+    events = list(events)
+    ts = [
+        e["ts"] for e in events if isinstance(e.get("ts"), (int, float))
+    ]
+    first = min(ts) if ts else None
+    last = max(ts) if ts else None
+    span = (last - first) if ts else 0.0
+    by_type: Dict[str, Dict[str, Any]] = {}
+    for e in events:
+        t = str(e.get("type", "?"))
+        rec = by_type.setdefault(
+            t, {"count": 0, "first_ts": None, "last_ts": None}
+        )
+        rec["count"] += 1
+        ets = e.get("ts")
+        if isinstance(ets, (int, float)):
+            if rec["first_ts"] is None or ets < rec["first_ts"]:
+                rec["first_ts"] = ets
+            if rec["last_ts"] is None or ets > rec["last_ts"]:
+                rec["last_ts"] = ets
+    for rec in by_type.values():
+        rec["rate_per_s"] = (
+            round(rec["count"] / span, 4) if span > 0 else None
+        )
+    return {
+        "total": len(events),
+        "first_ts": first,
+        "last_ts": last,
+        "span_s": round(span, 3) if ts else 0.0,
+        "by_type": dict(sorted(by_type.items())),
+    }
 
 
 def format_event(ev: Dict[str, Any]) -> str:

@@ -47,6 +47,7 @@ dependency-free and testable in-process.
 
 from __future__ import annotations
 
+import contextlib
 import inspect
 import json
 import logging
@@ -58,6 +59,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
 from luminaai_tpu.monitoring.events import FlightRecorder, get_recorder
+from luminaai_tpu.monitoring.watchdog import HangWatchdog, StepTimeSentinel
 from luminaai_tpu.monitoring.telemetry import (
     DEFAULT_LATENCY_BUCKETS,
     MetricsRegistry,
@@ -291,8 +293,14 @@ class ContinuousScheduler:
         prefix_cache_pages: Optional[int] = None,
         prefix_cache_tenant_quota: Optional[int] = None,
         tenant_weights: Optional[Dict[str, int]] = None,
+        watchdog: Optional[HangWatchdog] = None,
     ):
         self.engine = engine
+        # Hang watchdog (monitoring/watchdog.py): armed per generation,
+        # beaten once per decode step — a stuck decode executable fires
+        # hang_suspected + serving_hangs_total and dumps forensics
+        # (abort semantics are the watchdog's, not the scheduler's).
+        self.watchdog = watchdog
         # Default per-request deadline; a request's own timeout_s can only
         # shorten it. None = no deadline unless the request asks for one.
         self.request_timeout_s = request_timeout_s
@@ -409,6 +417,16 @@ class ContinuousScheduler:
             "Per-token decode latency (step duration, one observation "
             "per lane that produced a token)",
             buckets=buckets,
+        )
+        # Step-time anomaly sentinel (docs/observability.md "Goodput &
+        # sentinels"): robust rolling median/MAD over decode-step
+        # durations — serve_decode_step_seconds_{median,mad} gauges plus
+        # step_anomaly events when one step blows past the distribution.
+        self._sentinel = StepTimeSentinel(
+            registry=r,
+            recorder=self.recorder if self.telemetry else None,
+            prefix="serve_decode_step_seconds",
+            program="serve",
         )
         self._m_admissions = r.counter(
             "serve_admissions_total", "Requests admitted into a KV slot"
@@ -821,6 +839,16 @@ class ContinuousScheduler:
             # admission rather than spend prefill on a dead request.
             self._timeout(req, "while queued")
             return
+        with self._wd_pause():
+            self._admit_paused(req, active)
+
+    def _admit_paused(self, req: _ContinuousRequest, active: dict) -> None:
+        """_admit's body, under the watchdog pause: the prefill below can
+        hit a first-use XLA compile (new prompt bucket) that dwarfs the
+        rolling decode-step stats. The pause lives HERE — exactly where
+        prefill work happens — not per tick: pausing on a merely-nonempty
+        queue would exclude every interval on a saturated server and
+        starve the warmup, leaving real decode hangs undetectable."""
         slot = self.decoder.acquire_slot()
         t_admit = time.perf_counter()
         queue_wait = max(0.0, time.time() - req.t0)
@@ -983,6 +1011,16 @@ class ContinuousScheduler:
         scan moves on to the first runnable admission; only real chunk
         compute (or a resolution running its first chunk) ends the
         tick."""
+        if not self._prefilling:
+            return
+        with self._wd_pause():
+            self._advance_prefills_paused(active)
+
+    def _advance_prefills_paused(self, active: dict) -> None:
+        """_advance_prefills' body, watchdog-paused like _admit_paused:
+        a chunk advance can compile its executable on first use. Guarded
+        by the `_prefilling` check above, so steady decode-only ticks
+        never pause and the rolling stats keep warming."""
         for _ in range(max(1, len(self._prefilling))):
             if not self._prefilling:
                 return
@@ -1062,6 +1100,29 @@ class ContinuousScheduler:
         self.batches += 1
         if self.telemetry:
             self._m_generations.inc()
+        if self.watchdog is not None:
+            # Watch only while a generation is live: an idle scheduler
+            # parked on q.get() must never read as hung.
+            self.watchdog.arm()
+        try:
+            self._run_generation_inner(first)
+        finally:
+            if self.watchdog is not None:
+                self.watchdog.disarm()
+
+    def _wd_pause(self):
+        """Watchdog pause for the compile-prone host work between decode
+        steps (admission prefills, chunk advances — first-use XLA
+        compiles of new prompt/chunk buckets): the trainer's
+        skip_next-on-recompile guard, serving-shaped. Callers apply it
+        exactly around REAL prefill work, never per tick — pausing every
+        tick would exclude every beat interval and starve the rolling
+        stats. No-op without a watchdog."""
+        if self.watchdog is None:
+            return contextlib.nullcontext()
+        return self.watchdog.pause()
+
+    def _run_generation_inner(self, first: _ContinuousRequest) -> None:
         key = first.sample_key
         active: Dict[int, _ContinuousRequest] = {}
         self._admit(first, active)
@@ -1100,7 +1161,9 @@ class ContinuousScheduler:
             self._admit_queued(key, active)
             # One prefill chunk per tick: a long admission progresses
             # without ever costing the decode batch more than one
-            # chunk-sized forward between steps.
+            # chunk-sized forward between steps (_admit/_advance_prefills
+            # pause the watchdog internally, exactly around real prefill
+            # work — never on a merely-busy queue).
             self._advance_prefills(active)
             # Harvest batching (ROADMAP item 2): every prefix-cache
             # harvest that landed this tick rides ONE jitted bulk page
@@ -1124,9 +1187,14 @@ class ContinuousScheduler:
                     self._release_slot(slot)
                 self._prefilling.clear()
                 return
+            if self.watchdog is not None:
+                self.watchdog.beat()
             n_produced = sum(1 for slot in active if produced[slot])
             if self.telemetry:
                 self._m_step.observe(step_dt)
+                self._sentinel.observe(
+                    step_dt, step=int(getattr(self.decoder, "steps", 0))
+                )
                 self._m_decode_steps.inc()
                 # Per-token decode latency: the step IS the inter-token
                 # gap for every lane that emitted this step.
@@ -1244,6 +1312,10 @@ class ChatServer:
         tenant_weights: Optional[Dict[str, int]] = None,
         tenant_rate_per_s: Optional[float] = None,
         tenant_burst: Optional[int] = None,
+        watchdog: Any = "auto",
+        watchdog_abort: bool = False,
+        watchdog_k: Optional[float] = None,
+        watchdog_floor_s: Optional[float] = None,
     ):
         self.engine = engine
         self.telemetry = bool(telemetry)
@@ -1280,6 +1352,27 @@ class ChatServer:
             or (continuous == "auto" and hasattr(engine, "make_stepwise"))
         )
         if self.continuous:
+            # Serving hang watchdog: "auto" builds one over the flight
+            # dir (hang forensics land next to the drain dumps); pass
+            # None/False to disable, or a configured HangWatchdog to
+            # control thresholds (tests do).
+            if watchdog == "auto":
+                wd_kw = {}
+                if watchdog_k is not None:
+                    wd_kw["k"] = float(watchdog_k)
+                if watchdog_floor_s is not None:
+                    # --watchdog-floor: on cold fleets, raise above the
+                    # worst-case decode compile before enabling abort.
+                    wd_kw["floor_s"] = float(watchdog_floor_s)
+                watchdog = HangWatchdog(
+                    kind="serving",
+                    registry=self.registry,
+                    recorder=self.recorder,
+                    dump_dir=flight_dir,
+                    abort=watchdog_abort,
+                    **wd_kw,
+                )
+            self.watchdog = watchdog or None
             # Operator-supplied tenant weights are keyed by RAW identity
             # (or the literal "anon"); hash them here so raw identities
             # never live in scheduler state — the same tenant_hash the
@@ -1304,8 +1397,10 @@ class ChatServer:
                 prefix_cache_pages=prefix_cache_pages,
                 prefix_cache_tenant_quota=prefix_cache_tenant_quota,
                 tenant_weights=weights,
+                watchdog=self.watchdog,
             )
         else:
+            self.watchdog = None
             self.batcher = MicroBatcher(
                 engine, max_batch=max_batch, window_ms=batch_window_ms,
                 recorder=self.recorder, telemetry=telemetry,
@@ -1469,6 +1564,11 @@ class ChatServer:
         # flightrec-*.jsonl dump next to the checkpoints (lumina events
         # replays it; docs/observability.md "Flight recorder").
         self.dump_flight_record("drain")
+        # The server is done serving: stop the watchdog's monitor thread
+        # (Trainer.close does the same) — a drained server must not keep
+        # a poller alive in embedding processes that cycle servers.
+        if getattr(self, "watchdog", None) is not None:
+            self.watchdog.close()
         return idle
 
     def dump_flight_record(self, reason: str) -> Optional[str]:
@@ -2350,6 +2450,10 @@ def serve(
     prefix_cache_tenant_quota: Optional[int] = None,
     tenant_rate_per_s: Optional[float] = None,
     tenant_burst: Optional[int] = None,
+    watchdog: bool = True,
+    watchdog_abort: bool = False,
+    watchdog_k: Optional[float] = None,
+    watchdog_floor_s: Optional[float] = None,
 ):
     """Build an engine from a checkpoint and serve it (CLI `serve`)."""
     from luminaai_tpu.inference.chat import ChatInterface
@@ -2381,6 +2485,14 @@ def serve(
         # working dir) so a SIGTERM'd server leaves a queryable trail.
         flight_dir=flight_dir or checkpoint or ".",
         max_tenants=max_tenants,
+        # Hang watchdog over the decode loop (--no-watchdog disables;
+        # --watchdog-abort exits 75 on a confirmed stall so the
+        # orchestrator restarts the replica; --watchdog-k/--watchdog-floor
+        # tune the robust threshold).
+        watchdog=("auto" if watchdog else None),
+        watchdog_abort=watchdog_abort,
+        watchdog_k=watchdog_k,
+        watchdog_floor_s=watchdog_floor_s,
         latency_buckets=(
             tuple(latency_buckets)
             if latency_buckets

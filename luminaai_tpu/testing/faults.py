@@ -175,6 +175,58 @@ def truncated_checkpoint_writes(manager) -> Iterator[dict]:
 
 
 @contextlib.contextmanager
+def hang_step_at(
+    trainer, step_no: int, seconds: float = 2.0, times: int = 1
+) -> Iterator[dict]:
+    """Stall the `step_no`-th train_step CALL (1-based) for `seconds` of
+    wall clock before executing it — what a stuck DCN collective or a
+    wedged compile helper looks like from the host loop's seat. The step
+    eventually completes, so the watchdog's detect→dump→continue path
+    and (with an injected exit fn) detect→abort are both drivable from
+    one injector. Stalls `times` consecutive calls. Yields
+    {'calls', 'hangs'}."""
+    stats = {"calls": 0, "hangs": 0}
+    original = trainer.train_step
+
+    def wrapper(state, batch):
+        stats["calls"] += 1
+        if stats["calls"] >= step_no and stats["hangs"] < times:
+            stats["hangs"] += 1
+            time.sleep(seconds)
+        return original(state, batch)
+
+    trainer.train_step = wrapper
+    try:
+        yield stats
+    finally:
+        _restore(trainer, "train_step", wrapper, original)
+
+
+@contextlib.contextmanager
+def slow_tick(decoder, delay_s: float = 0.5, after: int = 3) -> Iterator[dict]:
+    """Serving hang injector: every decode_step AFTER the `after`-th
+    stalls `delay_s`. The fast warmup ticks build the serving watchdog's
+    rolling stats, then the tick time jumps — so what trips is the
+    ROBUST threshold crossing, not absolute slowness (contrast
+    slow_decode, which slows every step uniformly for deadline-eviction
+    tests). Yields {'steps'}."""
+    stats = {"steps": 0}
+    original = decoder.decode_step
+
+    def wrapper(*args, **kwargs):
+        stats["steps"] += 1
+        if stats["steps"] > after:
+            time.sleep(delay_s)
+        return original(*args, **kwargs)
+
+    decoder.decode_step = wrapper
+    try:
+        yield stats
+    finally:
+        _restore(decoder, "decode_step", wrapper, original)
+
+
+@contextlib.contextmanager
 def slow_decode(decoder, delay_s: float = 0.2) -> Iterator[dict]:
     """Slow/stuck-lane injector: every decode_step stalls `delay_s`, so a
     serving request with a deadline goes overdue mid-decode and the

@@ -19,6 +19,7 @@ tracking, OOM fallback) and training_loop.py. TPU-shape differences:
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import time
@@ -32,9 +33,15 @@ from jax.sharding import NamedSharding
 from luminaai_tpu.config import Config
 from luminaai_tpu.models.transformer import LuminaTransformer
 from luminaai_tpu.monitoring.events import FlightRecorder, get_recorder
+from luminaai_tpu.monitoring.goodput import GoodputLedger
 from luminaai_tpu.monitoring.logger import TrainingHealthMonitor
 from luminaai_tpu.monitoring.telemetry import MetricsRegistry, get_registry
 from luminaai_tpu.monitoring.tracing import NULL_TRACER, SpanTracer
+from luminaai_tpu.monitoring.watchdog import (
+    HangWatchdog,
+    StepTimeSentinel,
+    host_step_skew,
+)
 from luminaai_tpu.parallel.mesh import build_mesh, describe_mesh, initialize_multihost
 from luminaai_tpu.parallel.sharding import (
     batch_spec,
@@ -169,6 +176,38 @@ class Trainer:
         # recompile/preemption events land in the process ring; the
         # emergency-save paths dump it next to the checkpoints.
         self.recorder = recorder if recorder is not None else get_recorder()
+        # Runtime sentinel layer (docs/observability.md "Goodput &
+        # sentinels"): the goodput ledger partitions the run's wall
+        # clock per cause; the watchdog heartbeats at the log-window
+        # sync and fires on robust-threshold stalls; the sentinel flags
+        # step-time anomalies. All host-side clocks — no new syncs
+        # enter the step path.
+        self.goodput = GoodputLedger(
+            registry=self.registry, enabled=config.goodput
+        )
+        self.goodput.start("idle")
+        self.watchdog: Optional[HangWatchdog] = None
+        if config.watchdog:
+            self.watchdog = HangWatchdog(
+                kind="training",
+                registry=self.registry,
+                recorder=self.recorder,
+                dump_dir=str(ckpt_dir),
+                k=config.watchdog_k,
+                floor_s=config.watchdog_floor_s,
+                warmup=config.watchdog_warmup,
+                poll_s=config.watchdog_poll_s,
+                abort=config.watchdog_abort,
+                ledger=self.goodput,
+            )
+        self._sentinel = StepTimeSentinel(
+            registry=self.registry,
+            recorder=self.recorder,
+            prefix="train_step_seconds",
+            program="train",
+            k=config.step_anomaly_k,
+            enabled=config.step_anomaly,
+        )
         self.checkpoints = CheckpointManager(
             config, ckpt_dir, registry=self.registry
         )
@@ -285,7 +324,8 @@ class Trainer:
             )
         used = step
         try:
-            self.state = self.checkpoints.restore(self.state, step)
+            with self.goodput.region("checkpoint"):
+                self.state = self.checkpoints.restore(self.state, step)
         except Exception as e:
             # Architecture matches but the restore failed: the latest
             # checkpoint is corrupt/partial (kill mid-commit, disk-full).
@@ -303,10 +343,11 @@ class Trainer:
                 "falling back to an older intact one",
                 step, type(e).__name__, str(e)[:200],
             )
-            self.state, used, _ = self.checkpoints.restore_with_fallback(
-                self.state, step=max(older),
-                min_step=self._min_restorable_step,
-            )
+            with self.goodput.region("checkpoint"):
+                self.state, used, _ = self.checkpoints.restore_with_fallback(
+                    self.state, step=max(older),
+                    min_step=self._min_restorable_step,
+                )
         self.global_step = int(self.state.step)
         self._load_data_state(used)
         logger.info(
@@ -371,11 +412,19 @@ class Trainer:
         )
 
     def save_checkpoint(self, metrics=None, force: bool = False) -> None:
-        with self.tracer.span("checkpoint_save", step=self.global_step):
+        with self.tracer.span("checkpoint_save", step=self.global_step), \
+                self.goodput.region("checkpoint"), self._wd_pause():
             self.checkpoints.save(
                 self.state, self.global_step, metrics, force=force,
                 data_state=self._data_state(),
             )
+
+    def _wd_pause(self):
+        """Watchdog pause across legitimately-slow host work (eval,
+        blocking saves); no-op when the watchdog is off."""
+        if self.watchdog is None:
+            return contextlib.nullcontext()
+        return self.watchdog.pause()
 
     def request_stop(self, reason: str = "preemption") -> None:
         """Arm a graceful stop at the NEXT step boundary (SIGTERM/SIGINT
@@ -394,6 +443,12 @@ class Trainer:
             "recompile", step=self.global_step,
             reason=reason or "config_change",
         )
+        # A rebuilt step is a NEW timing regime: the sentinel's rolling
+        # stats would flag the first post-recompile window, and the
+        # watchdog would misprice the recompile stall as a hang.
+        self._sentinel.reset()
+        if self.watchdog is not None:
+            self.watchdog.skip_next()
 
     # -- adaptive hooks (called by the orchestrator) ----------------------
     def adjust_learning_rate(self, new_lr: float, reason: str = "") -> None:
@@ -832,7 +887,8 @@ class Trainer:
         if not candidates:
             return False  # never fall forward onto a possibly-tainted save
         target = max(candidates)
-        self.state = self.checkpoints.restore(self.state, target)
+        with self.goodput.region("checkpoint"), self._wd_pause():
+            self.state = self.checkpoints.restore(self.state, target)
         self.global_step = int(self.state.step)
         logger.warning("rolled back to step %d (%s)", target, reason)
         self._interventions.append(
@@ -872,7 +928,8 @@ class Trainer:
             return {}
         totals: Dict[str, float] = {}
         count = 0
-        with self.tracer.span("evaluate", step=self.global_step) as sp:
+        with self.tracer.span("evaluate", step=self.global_step) as sp, \
+                self.goodput.region("eval"), self._wd_pause():
             for i, batch in enumerate(self.eval_data()):
                 if i >= max_batches:
                     break
@@ -893,6 +950,39 @@ class Trainer:
         """Run to total_steps (or num_epochs when steps_per_epoch known).
 
         Returns a summary dict (ref trainer.py:3180 train)."""
+        try:
+            return self._train_inner()
+        finally:
+            # Whatever path exits (done, preempted, OOM ladder re-entry,
+            # propagated failure): the watchdog must stop watching a
+            # loop that no longer beats, and post-run time is idle.
+            if self.watchdog is not None:
+                self.watchdog.disarm()
+            self.goodput.switch("idle")
+
+    def _goodput_batches(self, host_iter):
+        """Attribute host-loop time blocked on the loader (incl. the
+        host->device put in _device_prefetch) to data_wait; replay time
+        the loader banked while fast-forwarding a resume is reattributed
+        to resume_replay INSIDE the open segment, so the partition and
+        the monotone counters both hold."""
+        it = iter(host_iter)
+        consume = getattr(
+            self.train_data, "consume_resume_replay_seconds", None
+        )
+        while True:
+            with self.goodput.region("data_wait"):
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    return
+                if consume is not None:
+                    replay = consume()
+                    if replay > 0:
+                        self.goodput.reattribute("resume_replay", replay)
+            yield batch
+
+    def _train_inner(self) -> Dict[str, Any]:
         cfg = self.config
         t_start = time.time()
         tokens_seen = 0
@@ -913,13 +1003,21 @@ class Trainer:
         window_t0 = time.time()
         window_tokens = 0
         window_steps = 0
+        self.goodput.switch("productive")
         while not stop and self.global_step < self.total_steps:
             epoch += 1
-            for batch in self._device_prefetch(self.train_data()):
+            for batch in self._goodput_batches(
+                self._device_prefetch(self.train_data())
+            ):
                 if self.global_step >= self.total_steps:
                     break
                 first_step = self.global_step == self._run_start_step
                 self._maybe_profile()
+                if first_step:
+                    # The first step call + its sync below IS the compile
+                    # window; the ledger flips back to productive (and
+                    # the watchdog arms) once the sync lands.
+                    self.goodput.switch("compile")
                 try:
                     self.state, metrics = self.train_step(self.state, batch)
                 except Exception as e:
@@ -943,6 +1041,13 @@ class Trainer:
                     if cfg.compiled_cost_analysis:
                         self._export_compiled_costs(batch)
                     self._export_grad_reduce_plan()
+                    self.goodput.switch("productive")
+                    if self.watchdog is not None:
+                        # Armed AFTER the compile sync: the watchdog's
+                        # rolling stats see only steady-state windows, so
+                        # a first compile can never trip it (and nothing
+                        # fires until `warmup` intervals exist anyway).
+                        self.watchdog.arm()
                     window_t0, window_tokens, window_steps = time.time(), 0, 0
 
                 if self.global_step % log_every == 0:
@@ -959,10 +1064,25 @@ class Trainer:
                         # Whole-window measurement (the float() above was
                         # the sync): mean step time observed once per step
                         # in the window, so histogram counts = steps.
+                        window_mean_s = (now - window_t0) / window_steps
                         self._m_step_time.observe(
-                            (now - window_t0) / window_steps,
-                            count=window_steps,
+                            window_mean_s, count=window_steps,
                         )
+                        # Anomaly sentinel: robust median/MAD check on
+                        # the window mean (train_step_seconds_{median,
+                        # mad} gauges + step_anomaly events).
+                        self._sentinel.observe(
+                            window_mean_s, step=self.global_step
+                        )
+                    if self.watchdog is not None:
+                        # Heartbeat at the synced boundary: a hang shows
+                        # as this beat never arriving.
+                        self.watchdog.beat()
+                    # Straggler signal: per-host completion skew at this
+                    # existing sync (one tiny all-gather on multihost
+                    # fleets; single-host sets the gauge to 0.0 with no
+                    # device work).
+                    host_step_skew(self.registry)
                     self._m_tps.set(scalars["tokens_per_sec"])
                     window_t0, window_tokens, window_steps = now, 0, 0
                     self.monitor.log_step(self.global_step, scalars)
@@ -1045,13 +1165,14 @@ class Trainer:
                     self.recorder.emit(
                         "preemption", step=self.global_step, reason=reason,
                     )
-                    self.checkpoints.emergency_save(
-                        self.state, self.global_step, reason=reason,
-                        data_state=self._data_state(),
-                    )
-                    # The trail must survive the exit: dump the last N
-                    # step/router events next to the emergency save.
-                    self._dump_flight_record(reason)
+                    with self.goodput.region("checkpoint"), self._wd_pause():
+                        self.checkpoints.emergency_save(
+                            self.state, self.global_step, reason=reason,
+                            data_state=self._data_state(),
+                        )
+                        # The trail must survive the exit: dump the last N
+                        # step/router events next to the emergency save.
+                        self._dump_flight_record(reason)
                     stop = True
                     break
             else:
@@ -1073,7 +1194,11 @@ class Trainer:
             final_eval = self.evaluate() if self.eval_data is not None else {}
             last_metrics.update(final_eval)
             self.save_checkpoint(last_metrics, force=True)
-        self.checkpoints.wait()
+        with self.goodput.region("checkpoint"), self._wd_pause():
+            # The final async flush can legitimately block for minutes on
+            # a big model — paused like every other slow host-work site,
+            # or a SUCCESSFUL run's last flush would read as a hang.
+            self.checkpoints.wait()
 
         elapsed = time.time() - t_start
         summary = {
@@ -1087,6 +1212,11 @@ class Trainer:
             "interventions": self._interventions,
             "preempted": self._preempted,
             "resumed_exact_data_state": self._resumed_exact_data_state,
+            # Wall-clock attribution for the trainer's whole life (the
+            # ledger opens at __init__): productive / compile /
+            # checkpoint / data_wait / resume_replay / eval / hang /
+            # idle, partitioned by construction.
+            "goodput": self.goodput.snapshot(),
         }
         logger.info("training done: %s", summary)
         return summary
@@ -1491,11 +1621,13 @@ class Trainer:
             "train_abort", step=self.global_step,
             reason="non-finite loss, no rollback point",
         )
-        self.checkpoints.emergency_save(
-            self.state, self.global_step, "non-finite loss, no rollback point",
-            data_state=self._data_state(),
-        )
-        self._dump_flight_record("non_finite")
+        with self.goodput.region("checkpoint"), self._wd_pause():
+            self.checkpoints.emergency_save(
+                self.state, self.global_step,
+                "non-finite loss, no rollback point",
+                data_state=self._data_state(),
+            )
+            self._dump_flight_record("non_finite")
         return True
 
     def _check_early_stopping(self, eval_loss: Optional[float]) -> bool:
@@ -1522,4 +1654,7 @@ class Trainer:
             except Exception:
                 pass
             self._profiling = False
+        if self.watchdog is not None:
+            self.watchdog.close()
         self.checkpoints.close()
+        self.goodput.stop()

@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""One-command MULTICHIP_r* capture for the two DCN hot paths.
+
+ROADMAP item 3's REMAINING work is "run MULTICHIP_r* on a real
+multi-host slice to capture measured stage timings for BOTH dcn paths"
+— the hierarchical expert all-to-all (parallel/expert_dispatch.py) and
+the hierarchical gradient reduction (parallel/grad_reduce.py). `cli
+diagnose` already times both rungs interactively; this script is the
+capture form: it runs the same timed probes (plus the connectivity
+probe's per-axis all-reduce) and writes one self-describing
+MULTICHIP_r<NN>.json next to the existing captures, so the on-hardware
+run is exactly:
+
+    python scripts/capture_multichip.py            # auto-numbers rNN
+    python scripts/capture_multichip.py --out MULTICHIP_r06.json
+
+On a single host with >= 4 devices the probes SIMULATE the dcn tier
+(strided cross-"host" rails over local devices) — the capture then
+validates the two-stage machinery and records `simulated_dcn: true` so
+nobody mistakes it for interconnect numbers. The CPU test harness runs
+it that way end to end (tests/test_goodput.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+_CAPTURE_RE = re.compile(r"MULTICHIP_r(\d+)\.json$")
+
+
+def next_capture_path(root: str = REPO_ROOT) -> str:
+    """First free MULTICHIP_r<NN>.json index after the committed ones."""
+    taken = [
+        int(m.group(1))
+        for name in os.listdir(root)
+        for m in [_CAPTURE_RE.match(name)]
+        if m
+    ]
+    return os.path.join(
+        root, f"MULTICHIP_r{(max(taken) + 1 if taken else 1):02d}.json"
+    )
+
+
+def capture(payload_mb: float = 4.0, iters: int = 5) -> dict:
+    """Run the timed diagnose stages for both dcn paths (+ the per-axis
+    connectivity all-reduce) and return the capture record. Each probe
+    degrades to an `error` field instead of killing the capture — a
+    half-broken fleet's record is exactly when you want the rest."""
+    import jax
+
+    from luminaai_tpu.monitoring.telemetry import MetricsRegistry
+
+    # Probe gauges land in a throwaway registry: a capture run on a
+    # training host must never clobber the live process's diagnose_*
+    # series (the grad_reduce_probe lesson, PR 11).
+    scratch = MetricsRegistry()
+    record: dict = {
+        "kind": "dcn_stage_timings",
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "platform": jax.devices()[0].platform,
+        "n_devices": jax.device_count(),
+        "processes": jax.process_count(),
+        "payload_mb": payload_mb,
+        "iters": iters,
+    }
+
+    def run(name, fn):
+        try:
+            record[name] = fn()
+        except Exception as e:
+            record[name] = {"error": f"{type(e).__name__}: {e}"}
+
+    from luminaai_tpu.parallel.expert_dispatch import expert_a2a_probe
+    from luminaai_tpu.parallel.grad_reduce import grad_reduce_probe
+    from luminaai_tpu.utils.environment import connectivity_probe
+
+    run(
+        "connectivity",
+        lambda: connectivity_probe(registry=scratch),
+    )
+    run(
+        "expert_a2a",
+        lambda: expert_a2a_probe(
+            payload_mb=payload_mb, iters=iters, registry=scratch
+        ),
+    )
+    run(
+        "grad_reduce",
+        lambda: grad_reduce_probe(
+            payload_mb=payload_mb, iters=iters, registry=scratch
+        ),
+    )
+    record["ok"] = all(
+        "error" not in record.get(k, {})
+        for k in ("expert_a2a", "grad_reduce")
+    )
+    return record
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--out",
+        help="output path (default: next free MULTICHIP_r<NN>.json at "
+             "the repo root)",
+    )
+    ap.add_argument("--payload-mb", type=float, default=4.0,
+                    help="per-probe payload size (default 4 MB)")
+    ap.add_argument("--iters", type=int, default=5,
+                    help="timed iterations per stage (default 5)")
+    ap.add_argument("--tag", help="freeform label stored in the record "
+                                  "(slice name, topology, ticket)")
+    args = ap.parse_args(argv)
+
+    record = capture(payload_mb=args.payload_mb, iters=args.iters)
+    if args.tag:
+        record["tag"] = args.tag
+
+    out = args.out or next_capture_path()
+    with open(out, "w", encoding="utf-8") as fh:
+        json.dump(record, fh, indent=2, default=str)
+        fh.write("\n")
+
+    for path_name in ("expert_a2a", "grad_reduce"):
+        rec = record.get(path_name, {})
+        if "error" in rec:
+            print(f"{path_name}: ERROR {rec['error']}")
+            continue
+        sim = " (simulated dcn)" if rec.get("simulated_dcn") else ""
+        print(f"{path_name}: dcn={rec.get('dcn')} x ici={rec.get('ici')}{sim}")
+        for stage, vals in (rec.get("stages") or {}).items():
+            print(f"  {stage}: {vals}")
+    print(f"capture -> {out}")
+    return 0 if record["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -568,3 +568,104 @@ def test_event_emit_overhead_is_small():
     for i in range(10_000):
         rec.emit("x", i=i)
     assert time.perf_counter() - t0 < 1.0
+
+
+# ---------------------------------------------------------------------------
+# recorder truthiness (the PR 7 footgun), --stats and --since
+# ---------------------------------------------------------------------------
+def test_empty_recorder_is_truthy_never_swapped_for_default():
+    """Regression: __len__ alone made an EMPTY recorder falsy, so
+    `recorder or get_recorder()` silently replaced a caller's explicit
+    recorder with the process default. __bool__ pins truthiness to
+    identity; the `recorder= None`-vs-empty distinction is what every
+    producer's `is None` check relies on."""
+    empty = FlightRecorder()
+    assert len(empty) == 0
+    assert bool(empty) is True
+    assert (empty or get_recorder()) is empty
+    # And a producer handed an explicit empty recorder writes THERE.
+    from luminaai_tpu.monitoring.logger import MetricsCollector
+
+    coll = MetricsCollector(recorder=empty)
+    coll.add_metric("loss", float("nan"), step=1)
+    assert empty.snapshot(type="alert"), "explicit recorder was bypassed"
+
+
+def test_events_stats_helper_counts_and_rates():
+    from luminaai_tpu.monitoring.events import events_stats
+
+    evs = [
+        {"type": "a", "ts": 100.0},
+        {"type": "a", "ts": 104.0},
+        {"type": "b", "ts": 110.0},
+    ]
+    st = events_stats(evs)
+    assert st["total"] == 3
+    assert st["first_ts"] == 100.0 and st["last_ts"] == 110.0
+    assert st["span_s"] == 10.0
+    assert st["by_type"]["a"]["count"] == 2
+    assert st["by_type"]["a"]["rate_per_s"] == pytest.approx(0.2)
+    assert st["by_type"]["b"]["first_ts"] == 110.0
+    # Degenerate inputs stay well-formed.
+    assert events_stats([])["total"] == 0
+    assert events_stats([{"type": "x"}])["by_type"]["x"]["rate_per_s"] is None
+
+
+def test_parse_since_durations_and_timestamps():
+    from luminaai_tpu.monitoring.events import parse_since
+
+    assert parse_since("90s", now=1000.0) == 910.0
+    assert parse_since("5m", now=1000.0) == 700.0
+    assert parse_since("2h", now=10000.0) == 2800.0
+    assert parse_since("123.5") == 123.5  # bare number = epoch ts
+    for bad in ("", "yesterday", "-5m", "5x", "nan", "inf", "-inf",
+                "nans", "infm"):
+        # nan/inf would otherwise parse as floats and silently filter
+        # EVERY event (exit 0, empty output) instead of exiting 2.
+        with pytest.raises(ValueError):
+            parse_since(bad)
+
+
+def test_filter_events_since_floor():
+    evs = [
+        {"type": "a", "ts": 10.0},
+        {"type": "a", "ts": 20.0},
+        {"type": "a"},  # no ts: dropped by a --since filter
+    ]
+    assert len(filter_events(evs, since=15.0)) == 1
+    assert len(filter_events(evs, since=5.0)) == 2
+
+
+def test_cli_events_stats_and_since(tmp_path, capsys):
+    """`lumina events --stats` summarizes, `--since` floors, and a bad
+    --since exits 2 like a bad --grep (the existing exit contract)."""
+    from luminaai_tpu.cli import main
+
+    rec = FlightRecorder()
+    rec.emit("train_step", step=1)
+    rec.emit("train_step", step=2)
+    rec.emit("hang_suspected", stalled_s=9.9)
+    dump = str(tmp_path / "flightrec-x.jsonl")
+    rec.dump(dump)
+
+    assert main(["events", "--stats", "--json", dump]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    st = json.loads(out[-1])
+    assert st["total"] == 3
+    assert st["by_type"]["train_step"]["count"] == 2
+    assert st["by_type"]["hang_suspected"]["count"] == 1
+
+    # Human table form renders without error and names the types.
+    assert main(["events", "--stats", dump]) == 0
+    out = capsys.readouterr().out
+    assert "train_step" in out and "hang_suspected" in out
+
+    # --since with a future floor filters everything out.
+    future = str(time.time() + 3600)
+    assert main(["events", "--since", future, "--json", dump]) == 0
+    assert capsys.readouterr().out.strip() == ""
+    # --since duration form keeps the just-emitted events.
+    assert main(["events", "--since", "5m", "--json", dump]) == 0
+    assert len(capsys.readouterr().out.strip().splitlines()) == 3
+    # Exit-code contract: bad --since is exit 2, no traceback.
+    assert main(["events", "--since", "yesterday", dump]) == 2

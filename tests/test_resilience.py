@@ -682,3 +682,198 @@ def test_cli_sigterm_exits_resumable_and_resumes(tmp_path):
     )
     assert summary2["final_step"] == killed_step + 3
     assert summary2["resumed_exact_data_state"] is True
+
+
+# ---------------------------------------------------------------------------
+# hang watchdog (docs/observability.md "Goodput & sentinels")
+# ---------------------------------------------------------------------------
+def _wd_cfg(out, **kw):
+    """Tight watchdog thresholds so a ~1s injected stall fires within
+    the test budget; the floor stays well above real step jitter."""
+    return tiny_cfg(
+        out, health_check_interval=10,  # log_every=1: per-step beats
+        watchdog_floor_s=0.4, watchdog_k=3.0, watchdog_warmup=2,
+        watchdog_poll_s=0.05, **kw,
+    )
+
+
+def test_watchdog_detects_hang_dumps_and_continues(tmp_path):
+    """hang_step_at stalls one step well past k x rolling median: the
+    watchdog emits hang_suspected, dumps all-thread stacks + the flight
+    ring next to the checkpoints, bumps training_hangs_total, the
+    goodput ledger books the stall as `hang` — and with abort OFF the
+    run completes normally."""
+    import glob
+
+    from luminaai_tpu.monitoring.events import FlightRecorder, read_events
+    from luminaai_tpu.testing.faults import hang_step_at
+    from luminaai_tpu.training.trainer import Trainer
+
+    rec, reg = FlightRecorder(), MetricsRegistry()
+    ckpt = str(tmp_path / "ckpt")
+    t = Trainer(_wd_cfg(tmp_path), train_data=gen_loader(),
+                checkpoint_dir=ckpt, registry=reg, recorder=rec)
+    with hang_step_at(t, 6, seconds=1.5) as stats:
+        summary = t.train()
+    t.close()
+    assert stats["hangs"] == 1
+    evs = rec.snapshot(type="hang_suspected")
+    assert evs, "watchdog never fired on a 1.5s stall"
+    assert evs[0]["stalled_s"] > evs[0]["threshold_s"] > 0
+    assert evs[0]["kind"] == "training" and evs[0]["abort"] is False
+    # Detect -> continue: the stalled step completed and the run ran on.
+    assert summary["final_step"] == t.config.max_steps
+    assert reg.snapshot()["training_hangs_total"] >= 1
+    assert summary["goodput"]["seconds"]["hang"] > 0
+    # Forensics on disk, replayable by the dump readers.
+    stacks = glob.glob(ckpt + "/stacks-*hang.txt")
+    dumps = glob.glob(ckpt + "/flightrec-*hang*.jsonl")
+    assert stacks and dumps
+    assert "thread" in open(stacks[0]).read()
+    assert any(
+        e["type"] == "hang_suspected" for e in read_events(dumps[0])
+    )
+
+
+def test_watchdog_abort_exits_resumable(tmp_path):
+    """--watchdog-abort: after detect + dump the watchdog calls the exit
+    fn with RESUMABLE_EXIT=75 (injected here — the real fn is os._exit,
+    driven end to end by the CI hang smoke)."""
+    from luminaai_tpu.monitoring.events import FlightRecorder
+    from luminaai_tpu.monitoring.watchdog import RESUMABLE_EXIT
+    from luminaai_tpu.testing.faults import hang_step_at
+    from luminaai_tpu.training.trainer import Trainer
+
+    rec = FlightRecorder()
+    t = Trainer(_wd_cfg(tmp_path, watchdog_abort=True),
+                train_data=gen_loader(),
+                checkpoint_dir=str(tmp_path / "ckpt"),
+                registry=MetricsRegistry(), recorder=rec)
+    exits = []
+    t.watchdog._exit_fn = exits.append
+    with hang_step_at(t, 5, seconds=1.2):
+        t.train()
+    t.close()
+    assert exits == [RESUMABLE_EXIT], exits
+    evs = rec.snapshot(type="hang_suspected")
+    assert evs and evs[0]["abort"] is True
+
+
+def test_watchdog_quiet_during_first_compile_and_clean_run(tmp_path):
+    """No-false-positive contract: the watchdog arms AFTER the first
+    compile sync and needs `warmup` intervals before it can fire — a
+    multi-second first compile over ~10ms steps never trips it, and an
+    uninjected run stays silent end to end."""
+    from luminaai_tpu.monitoring.events import FlightRecorder
+    from luminaai_tpu.training.trainer import Trainer
+
+    rec, reg = FlightRecorder(), MetricsRegistry()
+    t = Trainer(_wd_cfg(tmp_path), train_data=gen_loader(),
+                checkpoint_dir=str(tmp_path / "ckpt"),
+                registry=reg, recorder=rec)
+    summary = t.train()
+    t.close()
+    assert summary["final_step"] == t.config.max_steps
+    assert not rec.snapshot(type="hang_suspected")
+    assert reg.snapshot().get("training_hangs_total", 0) == 0
+
+
+def test_serving_watchdog_detects_slow_tick(tmp_path):
+    """The scheduler arms the watchdog per generation and beats per
+    decode step: slow_tick's post-warmup stall crosses the robust
+    threshold -> hang_suspected + serving_hangs_total, while the
+    request itself still completes (detect -> continue)."""
+    from luminaai_tpu.monitoring.events import FlightRecorder
+    from luminaai_tpu.monitoring.watchdog import HangWatchdog
+    from luminaai_tpu.testing.faults import slow_tick
+
+    rec, reg = FlightRecorder(), MetricsRegistry()
+    eng = _Engine()
+    wd = HangWatchdog(
+        kind="serving", registry=reg, recorder=rec,
+        dump_dir=str(tmp_path), k=3.0, floor_s=0.25, warmup=2,
+        poll_s=0.03,
+    )
+    sched = ContinuousScheduler(
+        eng, decoder=eng.stepper, registry=reg, recorder=rec,
+        watchdog=wd,
+    )
+    with slow_tick(eng.stepper, delay_s=0.8, after=6):
+        toks, stats = sched.submit([40], {"max_new_tokens": 10})
+    wd.close()
+    assert toks == list(range(40, 50))  # the lane still finished
+    evs = rec.snapshot(type="hang_suspected")
+    assert evs and evs[0]["kind"] == "serving"
+    assert reg.snapshot()["serving_hangs_total"] >= 1
+    # Idle scheduler (generation over, watchdog disarmed): no re-fire.
+    time.sleep(0.4)
+    assert wd.fires == len(evs)
+
+
+def test_serving_sentinel_flags_decode_step_anomaly():
+    """One decode step blowing past the rolling median/MAD emits a
+    step_anomaly event tagged program=serve and keeps the
+    serve_decode_step_seconds_{median,mad} gauges fresh."""
+    from luminaai_tpu.monitoring.events import FlightRecorder
+    from luminaai_tpu.testing.faults import slow_tick
+
+    rec, reg = FlightRecorder(), MetricsRegistry()
+    eng = _Engine()
+    sched = ContinuousScheduler(
+        eng, decoder=eng.stepper, registry=reg, recorder=rec,
+    )
+    with slow_tick(eng.stepper, delay_s=0.3, after=8):
+        sched.submit([40], {"max_new_tokens": 12})
+    evs = rec.snapshot(type="step_anomaly")
+    assert evs and evs[0]["program"] == "serve"
+    snap = reg.snapshot()
+    assert snap["serve_decode_step_seconds_median"] > 0
+    assert snap["step_time_anomalies_total"]["program=serve"] >= 1
+
+
+def test_serving_watchdog_ignores_slow_admission_prefill(tmp_path):
+    """A mid-generation admission whose prefill stalls past the floor
+    (first-use XLA compile of a new prompt bucket on real engines) is
+    excluded via the scheduler's pause — no false hang fires, and the
+    watchdog still watches the decode steps around it."""
+    from luminaai_tpu.monitoring.events import FlightRecorder
+    from luminaai_tpu.monitoring.watchdog import HangWatchdog
+
+    rec, reg = FlightRecorder(), MetricsRegistry()
+    eng = _Engine()
+    orig_prefill = eng.stepper.prefill_into_slot
+
+    def slow_prefill(*a, **kw):
+        time.sleep(0.6)  # > floor: would fire if not paused
+        return orig_prefill(*a, **kw)
+
+    eng.stepper.prefill_into_slot = slow_prefill
+    wd = HangWatchdog(
+        kind="serving", registry=reg, recorder=rec,
+        dump_dir=str(tmp_path), k=3.0, floor_s=0.25, warmup=2,
+        poll_s=0.03,
+    )
+    sched = ContinuousScheduler(
+        eng, decoder=eng.stepper, registry=reg, recorder=rec,
+        watchdog=wd,
+    )
+    # Two requests: the second admits mid-generation through the paused
+    # admission path while the first keeps decoding.
+    results = []
+
+    def submit(prompt, n):
+        results.append(sched.submit([prompt], {"max_new_tokens": n}))
+
+    t1 = threading.Thread(target=submit, args=(40, 30))
+    t1.start()
+    time.sleep(0.15)  # let A's generation start
+    t2 = threading.Thread(target=submit, args=(80, 5))
+    t2.start()
+    t1.join(timeout=30)
+    t2.join(timeout=30)
+    wd.close()
+    assert len(results) == 2
+    assert not rec.snapshot(type="hang_suspected"), (
+        rec.snapshot(type="hang_suspected")
+    )
+    assert reg.snapshot().get("serving_hangs_total", 0) == 0
