@@ -1239,6 +1239,51 @@ def cmd_events(args) -> int:
     return 0
 
 
+def cmd_verify_checkpoint(args) -> int:
+    """Walk a checkpoint directory's integrity manifests
+    (docs/resilience.md "Durable I/O"): per-step ok / corrupt /
+    unmanifested. Exit 0 when every verified step is intact
+    (unmanifested legacy steps are reported, not failed), 1 on any
+    corruption, 2 when the directory/step does not exist — the same
+    exit-code contract shape as `lumina events`."""
+    from luminaai_tpu.training.checkpoint import verify_checkpoint_dir
+
+    try:
+        report = verify_checkpoint_dir(
+            args.dir, step=args.step, mode=args.mode
+        )
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(report, default=str))
+    else:
+        header = f"{'step':>8}  {'status':<14}{'files':>7}{'hashed':>8}  detail"
+        print(f"checkpoint manifests under {report['root']} "
+              f"(mode={report['mode']})")
+        print(header)
+        print("-" * len(header))
+        for s, rep in sorted(report["steps"].items()):
+            detail = ""
+            if rep["mismatches"]:
+                m = rep["mismatches"][0]
+                detail = f"{m['file']}: {m['reason']}"
+                if len(rep["mismatches"]) > 1:
+                    detail += f" (+{len(rep['mismatches']) - 1} more)"
+            print(
+                f"{s:>8}  {rep['status']:<14}{rep['files']:>7}"
+                f"{rep['hashed']:>8}  {detail}"
+            )
+        print(
+            f"{len(report['ok'])} ok, {len(report['corrupt'])} corrupt, "
+            f"{len(report['unmanifested'])} unmanifested"
+        )
+    if not report["steps"]:
+        print(f"no checkpoint steps under {args.dir}", file=sys.stderr)
+        return 2
+    return 1 if report["corrupt"] else 0
+
+
 def cmd_presets(args) -> int:
     from luminaai_tpu.config import ConfigPresets
 
@@ -1702,6 +1747,20 @@ def build_parser() -> argparse.ArgumentParser:
                     help="one JSON record per line (pipe into jq); with "
                          "--stats, the summary as one JSON object")
     ev.set_defaults(fn=cmd_events)
+
+    vc = sub.add_parser(
+        "verify-checkpoint",
+        help="verify checkpoint integrity manifests (exit 1 on corruption)",
+    )
+    vc.add_argument("dir", help="checkpoint directory (holds <step>/ dirs)")
+    vc.add_argument("--step", type=int, default=None,
+                    help="verify one step only (default: every step)")
+    vc.add_argument("--mode", choices=("full", "sample"), default="full",
+                    help="full = hash every manifested file; sample = "
+                         "sizes for all, hashes for a deterministic "
+                         "subset (fast mode for huge checkpoints)")
+    vc.add_argument("--json", action="store_true")
+    vc.set_defaults(fn=cmd_verify_checkpoint)
 
     s = sub.add_parser("presets", help="list model presets")
     s.add_argument("--json", action="store_true")

@@ -364,6 +364,31 @@ class Config:
     # (no gauges, no events).
     step_anomaly: bool = True
     step_anomaly_k: float = 4.0
+    # --- Durable I/O (docs/resilience.md "Durable I/O") ---
+    # Storage ops (checkpoint save/restore, manifest writes, data opens/
+    # reads) retry transient faults with exponential backoff + jitter:
+    # io_retries total attempts per op, delays io_retry_base_s doubling
+    # up to io_retry_max_s, the whole op bounded by io_timeout_s when
+    # set. Retry waits accrue to the already-open goodput cause
+    # (checkpoint / data_wait).
+    io_retries: int = 4
+    io_retry_base_s: float = 0.05
+    io_retry_max_s: float = 2.0
+    io_timeout_s: Optional[float] = None
+    # Checkpoint integrity: restore verifies each step's sha256 manifest
+    # — 'full' hashes every file, 'sample' hashes a deterministic subset
+    # (sizes always checked; the fast mode for huge checkpoints), 'off'
+    # disables. A mismatch walks back like any corrupt checkpoint.
+    checkpoint_verify: str = "full"
+    # Emergency saves fall back to this local directory when the primary
+    # checkpoint dir is unwritable (None disables the tier).
+    checkpoint_local_tier: Optional[str] = None
+    # Degraded-mode data loading: corrupt/truncated records are
+    # quarantined (counted + flight-evented, run continues) instead of
+    # raising; a quarantine rate above the fence aborts so silent data
+    # loss can't masquerade as health.
+    data_quarantine: bool = True
+    data_quarantine_max_rate: float = 0.05
 
     # --- Adaptive control (orchestrator) ---
     enable_adaptive_lr: bool = True
@@ -517,6 +542,20 @@ class Config:
         assert self.watchdog_warmup >= 1, "watchdog_warmup must be >= 1"
         assert self.watchdog_poll_s > 0, "watchdog_poll_s must be positive"
         assert self.step_anomaly_k > 1, "step_anomaly_k must be > 1"
+        assert self.io_retries >= 1, "io_retries must be >= 1 (1 = no retry)"
+        assert self.io_retry_base_s > 0, "io_retry_base_s must be positive"
+        assert self.io_retry_max_s >= self.io_retry_base_s, (
+            "io_retry_max_s must be >= io_retry_base_s"
+        )
+        if self.io_timeout_s is not None:
+            assert self.io_timeout_s > 0, "io_timeout_s must be positive"
+        assert self.checkpoint_verify in ("full", "sample", "off"), (
+            f"invalid checkpoint_verify {self.checkpoint_verify!r} "
+            "(one of full/sample/off)"
+        )
+        assert 0.0 < self.data_quarantine_max_rate <= 1.0, (
+            "data_quarantine_max_rate must be in (0, 1]"
+        )
         if self.use_moe:
             assert self.moe_top_k <= self.num_experts, "moe_top_k must be <= num_experts"
             assert self.moe_pattern in MOE_PATTERNS, (

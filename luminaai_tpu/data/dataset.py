@@ -32,10 +32,49 @@ import numpy as np
 from luminaai_tpu.config import Config
 from luminaai_tpu.data.tokenizer import ConversationTokenizer
 from luminaai_tpu.native import pack_batch, shuffle_indices
+from luminaai_tpu.utils.retry import RetryPolicy, io_call
 
 logger = logging.getLogger(__name__)
 
 CACHE_VERSION = 1
+
+# -- degraded-mode loading (docs/resilience.md "Durable I/O") ---------------
+# A corrupt or truncated record is quarantined — counted, flight-evented,
+# skipped — and the run continues; a quarantine RATE above the fence
+# aborts, so silent data loss can't masquerade as health. Events are
+# capped per reader so a garbage file can't flood the flight ring.
+QUARANTINE_MIN_RECORDS = 20  # fence only judges after this many records
+_QUARANTINE_EVENT_CAP = 16   # per-reader flight-event budget
+
+
+class DataCorruptionError(RuntimeError):
+    """Corrupt data encountered with quarantine off, or the quarantine
+    rate crossed the fence (the stream is rotten, not merely scuffed)."""
+
+
+class TokenCacheError(RuntimeError):
+    """A TokenCache failed open-time consistency validation. The message
+    says what to do; downstream index crashes no longer speak for it."""
+
+
+def _quarantine_counter():
+    from luminaai_tpu.monitoring.telemetry import get_registry
+
+    return get_registry().counter(
+        "data_records_quarantined_total",
+        "Corrupt/truncated data records skipped by degraded-mode "
+        "loading, by bounded reason",
+        labelnames=("reason",),
+    )
+
+
+def _quarantine_event(**fields) -> None:
+    try:
+        from luminaai_tpu.monitoring.events import get_recorder
+
+        get_recorder().emit("data_quarantine", **fields)
+    except Exception:  # pragma: no cover - telemetry never kills loading
+        logger.debug("data_quarantine event emit failed", exc_info=True)
 
 
 # ---------------------------------------------------------------------------
@@ -87,11 +126,74 @@ class TokenCache:
         self.meta_path.write_text(json.dumps(self.meta))
         return self.open()
 
-    def open(self) -> "TokenCache":
-        self.meta = json.loads(self.meta_path.read_text())
-        self.tokens = np.memmap(self.tokens_path, dtype=np.int32, mode="r")
-        self.offsets = np.load(self.offsets_path)
+    def open(self, validate: bool = True) -> "TokenCache":
+        """mmap the cache files (through the durable-I/O retry layer)
+        and validate their mutual consistency: a truncated `.tokens`
+        file or stale offset table used to surface as an index crash
+        deep inside the packer; now it is ONE actionable error here."""
+        self.meta = json.loads(
+            io_call(self.meta_path.read_text, op="data_open")
+        )
+        try:
+            self.tokens = io_call(
+                np.memmap, self.tokens_path, dtype=np.int32, mode="r",
+                op="data_open",
+            )
+        except ValueError as e:
+            # A byte count that is not a multiple of int32 is itself the
+            # truncation evidence — same actionable error, not numpy's.
+            # (A zero-byte file is a different defect: an empty or
+            # failed build, not a truncated one.)
+            size = self.tokens_path.stat().st_size
+            detail = (
+                ".tokens.bin is empty (zero tokens — empty or failed "
+                "build)"
+                if size == 0
+                else f".tokens.bin size {size} is not a whole number of "
+                     f"int32 tokens ({e}) — truncated .tokens.bin"
+            )
+            raise TokenCacheError(
+                f"token cache {self.stem} failed validation: {detail}; "
+                f"delete {self.stem}.* and rebuild the cache "
+                "(build_text_cache(..., rebuild=True))"
+            ) from e
+        self.offsets = io_call(np.load, self.offsets_path, op="data_open")
+        if validate:
+            self.validate()
         return self
+
+    def validate(self) -> None:
+        """Offsets/tokens/meta consistency; raises TokenCacheError with
+        the repair instruction instead of letting a downstream packer
+        index crash speak for the corruption."""
+        problems = []
+        off = self.offsets
+        if off is None or getattr(off, "ndim", None) != 1 or len(off) < 1:
+            problems.append("offset table empty or malformed")
+        else:
+            if int(off[0]) != 0:
+                problems.append(f"first offset is {int(off[0])}, not 0")
+            if len(off) > 1 and bool(np.any(np.diff(off) < 0)):
+                problems.append("offset table not monotone nondecreasing")
+            n_tok = int(self.tokens.size)
+            if int(off[-1]) > n_tok:
+                problems.append(
+                    f"last offset {int(off[-1])} exceeds token count "
+                    f"{n_tok} (truncated .tokens.bin)"
+                )
+            meta_docs = self.meta.get("n_docs")
+            if meta_docs is not None and meta_docs != len(off) - 1:
+                problems.append(
+                    f"meta n_docs {meta_docs} != offset table's "
+                    f"{len(off) - 1} (stale meta)"
+                )
+        if problems:
+            raise TokenCacheError(
+                f"token cache {self.stem} failed validation: "
+                + "; ".join(problems)
+                + f" — delete {self.stem}.* and rebuild the cache "
+                "(build_text_cache(..., rebuild=True))"
+            )
 
     @property
     def n_docs(self) -> int:
@@ -105,18 +207,77 @@ class TokenCache:
 # ---------------------------------------------------------------------------
 # Conversation dataset (chat finetuning)
 # ---------------------------------------------------------------------------
-def read_jsonl(path: str, max_records: Optional[int] = None) -> Iterator[Dict]:
-    with open(path) as f:
-        for i, line in enumerate(f):
+def read_jsonl(
+    path: str,
+    max_records: Optional[int] = None,
+    quarantine: bool = True,
+    max_quarantine_rate: float = 0.05,
+    retry: Optional[RetryPolicy] = None,
+) -> Iterator[Dict]:
+    """jsonl records with degraded-mode loading (docs/resilience.md).
+
+    Opens through the durable-I/O retry layer and reads BINARY: a
+    truncated trailing line — the normal artifact of a preempted writer,
+    which used to crash this reader when the cut landed mid-UTF-8
+    sequence — is always skipped with a counter. Mid-file corruption is
+    quarantined (counter + `data_quarantine` flight event, stream
+    continues) while `quarantine` is on, else raises
+    DataCorruptionError. A quarantine rate above `max_quarantine_rate`
+    (judged after QUARANTINE_MIN_RECORDS) aborts the read either way:
+    past the fence the file is rotten, and silently training on its
+    survivors would masquerade as health.
+
+    JsonlIndex.record mirrors this contract for random access (it
+    cannot stream through here) — a contract change must land in both
+    places."""
+    f = io_call(open, path, "rb", op="data_open", policy=retry)
+    good = bad = events = 0
+    with f:
+        for i, raw in enumerate(f):
             if max_records is not None and i >= max_records:
                 break
-            line = line.strip()
+            line = raw.strip()
             if not line:
                 continue
             try:
-                yield json.loads(line)
-            except json.JSONDecodeError:
-                logger.warning("%s:%d bad json skipped", path, i + 1)
+                rec = json.loads(line)
+            except ValueError as e:  # JSONDecodeError / UnicodeDecodeError
+                bad += 1
+                truncated_tail = not raw.endswith(b"\n")
+                reason = (
+                    "truncated_tail" if truncated_tail else "bad_record"
+                )
+                if not truncated_tail and not quarantine:
+                    raise DataCorruptionError(
+                        f"{path}:{i + 1}: corrupt jsonl record ({e}); "
+                        "enable config.data_quarantine to skip corrupt "
+                        "records, or repair the file"
+                    ) from e
+                _quarantine_counter().labels(reason=reason).inc()
+                if events < _QUARANTINE_EVENT_CAP:
+                    events += 1
+                    _quarantine_event(
+                        path=str(path), line=i + 1, reason=reason,
+                    )
+                logger.warning(
+                    "%s:%d %s skipped (%d quarantined so far)",
+                    path, i + 1, reason, bad,
+                )
+                total = good + bad
+                if (
+                    not truncated_tail
+                    and total >= QUARANTINE_MIN_RECORDS
+                    and bad / total > max_quarantine_rate
+                ):
+                    raise DataCorruptionError(
+                        f"{path}: quarantine rate {bad}/{total} exceeds "
+                        f"the {max_quarantine_rate:.0%} fence — refusing "
+                        "to silently train on the survivors of a rotten "
+                        "file; repair or regenerate it"
+                    ) from e
+                continue
+            good += 1
+            yield rec
 
 
 class JsonlIndex:
@@ -129,11 +290,23 @@ class JsonlIndex:
     (ref core/dataset.py FastStreamingBaseTrainingDataset role).
     """
 
-    def __init__(self, path: str):
+    def __init__(
+        self,
+        path: str,
+        quarantine: bool = True,
+        max_quarantine_rate: float = 0.05,
+    ):
         import mmap
 
         self.path = path
-        self._f = open(path, "rb")
+        # Same degraded-mode contract as read_jsonl: quarantine off makes
+        # a corrupt record fatal, and a quarantine rate past the fence
+        # aborts either way (docs/resilience.md "Durable I/O").
+        self.quarantine = quarantine
+        self.max_quarantine_rate = max_quarantine_rate
+        self._good = 0
+        self._bad = 0
+        self._f = io_call(open, path, "rb", op="data_open")
         size = os.fstat(self._f.fileno()).st_size
         self._mm = (
             mmap.mmap(self._f.fileno(), 0, access=mmap.ACCESS_READ)
@@ -155,14 +328,49 @@ class JsonlIndex:
         return self._mm[beg:end]
 
     def record(self, i: int) -> Optional[Dict]:
-        line = self.raw(i).strip()
+        raw = self.raw(i)
+        line = raw.strip()
         if not line:
             return None
         try:
-            return json.loads(line)
-        except json.JSONDecodeError:
+            rec = json.loads(line)
+        except ValueError as e:  # JSONDecodeError / UnicodeDecodeError
+            # Same contract as read_jsonl: a truncated trailing line
+            # (last record, no final newline — the preempted-writer
+            # artifact) is ALWAYS skipped; only mid-file corruption is
+            # fatal with quarantine off or counted against the fence.
+            if i == len(self.starts) - 1 and not raw.endswith(b"\n"):
+                _quarantine_counter().labels(
+                    reason="truncated_tail"
+                ).inc()
+                logger.warning(
+                    "%s: truncated trailing record %d skipped",
+                    self.path, i,
+                )
+                return None
+            if not self.quarantine:
+                raise DataCorruptionError(
+                    f"{self.path}: corrupt jsonl record {i} ({e}); "
+                    "enable config.data_quarantine to skip corrupt "
+                    "records, or repair the file"
+                ) from e
+            self._bad += 1
+            _quarantine_counter().labels(reason="bad_record").inc()
             logger.warning("%s: bad json at record %d skipped", self.path, i)
+            total = self._good + self._bad
+            if (
+                total >= QUARANTINE_MIN_RECORDS
+                and self._bad / total > self.max_quarantine_rate
+            ):
+                raise DataCorruptionError(
+                    f"{self.path}: quarantine rate {self._bad}/{total} "
+                    f"exceeds the {self.max_quarantine_rate:.0%} fence — "
+                    "refusing to silently train on the survivors of a "
+                    "rotten file; repair or regenerate it"
+                ) from e
             return None
+        self._good += 1
+        return rec
 
     def iter_shuffled(self, seed: int) -> Iterator[Dict]:
         from luminaai_tpu.native import shuffle_indices
@@ -204,8 +412,20 @@ class ConversationDataset:
         if not self.streaming:
             self._load_eager()
 
+    def _read(self) -> Iterator[Dict]:
+        """This dataset's jsonl stream with the config's degraded-mode
+        loading switches applied."""
+        return read_jsonl(
+            self.path,
+            quarantine=getattr(self.config, "data_quarantine", True),
+            max_quarantine_rate=getattr(
+                self.config, "data_quarantine_max_rate", 0.05
+            ),
+            retry=RetryPolicy.from_config(self.config),
+        )
+
     def _load_eager(self) -> None:
-        for conv in read_jsonl(self.path):
+        for conv in self._read():
             enc = self.tokenizer.encode_conversation(
                 conv,
                 max_length=self.config.seq_length,
@@ -237,7 +457,13 @@ class ConversationDataset:
         if shuffle_seed is not None:
             # Shuffled streaming: mmap + native newline index gives O(1)-
             # memory random access instead of sequential-only epochs.
-            index = JsonlIndex(self.path)
+            index = JsonlIndex(
+                self.path,
+                quarantine=getattr(self.config, "data_quarantine", True),
+                max_quarantine_rate=getattr(
+                    self.config, "data_quarantine_max_rate", 0.05
+                ),
+            )
             try:
                 convs: Iterator[Dict] = index.iter_shuffled(shuffle_seed)
                 for conv in convs:
@@ -251,7 +477,7 @@ class ConversationDataset:
             finally:
                 index.close()
             return
-        for conv in read_jsonl(self.path):
+        for conv in self._read():
             enc = self.tokenizer.encode_conversation(
                 conv,
                 max_length=self.config.seq_length,
@@ -523,6 +749,11 @@ class PackedDataset:
             while buf_tokens < need and pi < len(order):
                 d = int(order[pi])
                 pi += 1
+                # No retry wrap here: a storage fault on a memmap
+                # page-in surfaces as SIGBUS (process death), never a
+                # catchable OSError, so a retry could not fire — and
+                # this is the packing hot loop. The retry layer covers
+                # the POSIX reads (cache open, offsets, meta).
                 arr = np.asarray(tokens[offsets[d]:offsets[d + 1]])
                 if arr.size:
                     buf_docs.append(arr)
@@ -832,6 +1063,8 @@ def build_text_cache(
     tokenizer: ConversationTokenizer,
     text_key: str = "text",
     rebuild: bool = False,
+    quarantine: bool = True,
+    max_quarantine_rate: float = 0.05,
 ) -> TokenCache:
     """Tokenize a jsonl of {text_key: str} docs into a TokenCache."""
     cache = TokenCache(cache_stem)
@@ -839,7 +1072,10 @@ def build_text_cache(
         return cache.open()
 
     def docs():
-        for rec in read_jsonl(jsonl_path):
+        for rec in read_jsonl(
+            jsonl_path, quarantine=quarantine,
+            max_quarantine_rate=max_quarantine_rate,
+        ):
             text = rec.get(text_key)
             if text:
                 yield tokenizer.encode_text(text) + [tokenizer.eos_token_id]

@@ -27,6 +27,8 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
 
+from luminaai_tpu.utils.retry import io_call
+
 logger = logging.getLogger(__name__)
 
 
@@ -176,7 +178,10 @@ class SourceProcessor:
         for path in inputs:
             p = Path(path)
             if p.suffix == ".jsonl":
-                with p.open(encoding="utf-8", errors="replace") as f:
+                with io_call(
+                    p.open, encoding="utf-8", errors="replace",
+                    op="data_open",
+                ) as f:
                     for line in f:
                         try:
                             rec = json.loads(line)
@@ -242,10 +247,20 @@ class MultiSourcePipeline:
     sources interleaved for shuffle-free streaming.)
     """
 
-    def __init__(self, tokenizer, weights: Dict[str, float]):
+    def __init__(
+        self,
+        tokenizer,
+        weights: Dict[str, float],
+        quarantine: bool = True,
+        max_quarantine_rate: float = 0.05,
+    ):
         self.tokenizer = tokenizer
         total = sum(weights.values())
         self.weights = {k: v / total for k, v in weights.items()}
+        # Degraded-mode loading contract for shard reads (same switches
+        # as config.data_quarantine / data_quarantine_max_rate).
+        self.quarantine = quarantine
+        self.max_quarantine_rate = max_quarantine_rate
 
     def iter_blended(
         self,
@@ -264,14 +279,22 @@ class MultiSourcePipeline:
         return it
 
     @staticmethod
-    def _iter_shards(paths: Sequence[str]) -> Iterator[Dict[str, Any]]:
+    def _iter_shards(
+        paths: Sequence[str],
+        quarantine: bool = True,
+        max_quarantine_rate: float = 0.05,
+    ) -> Iterator[Dict[str, Any]]:
+        # Delegates to read_jsonl so shard reads carry the WHOLE
+        # degraded-mode contract (retried opens, truncated-tail skip,
+        # quarantine-off fatality, rate fence) — one implementation,
+        # not a drifting copy.
+        from luminaai_tpu.data.dataset import read_jsonl
+
         for p in paths:
-            with open(p) as f:
-                for line in f:
-                    try:
-                        yield json.loads(line)
-                    except json.JSONDecodeError:
-                        continue
+            yield from read_jsonl(
+                p, quarantine=quarantine,
+                max_quarantine_rate=max_quarantine_rate,
+            )
 
     def build_cache(
         self, shards: Dict[str, Sequence[str]], cache_stem: str, seed: int = 0
@@ -330,7 +353,11 @@ class BlendIterator:
         self.emitted = 0
         self.per_source = {}
         iters = {
-            name: MultiSourcePipeline._iter_shards(paths)
+            name: MultiSourcePipeline._iter_shards(
+                paths,
+                quarantine=self.pipeline.quarantine,
+                max_quarantine_rate=self.pipeline.max_quarantine_rate,
+            )
             for name, paths in self.shards.items()
             if name in self.pipeline.weights
         }

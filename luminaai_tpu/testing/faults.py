@@ -227,6 +227,95 @@ def slow_tick(decoder, delay_s: float = 0.5, after: int = 3) -> Iterator[dict]:
 
 
 @contextlib.contextmanager
+def flaky_storage(
+    times: int = 3,
+    ops: Optional[tuple] = None,
+    error_factory: Optional[Callable[[str], BaseException]] = None,
+) -> Iterator[dict]:
+    """Make the first `times` durable-I/O operations raise a TRANSIENT
+    error before the real call runs, then succeed — a flaky GCS/NFS
+    mount as seen from the retry seam (utils/retry.set_fault_hook), so
+    the whole backoff ladder is exercised through the REAL call sites
+    (checkpoint save/restore, jsonl opens, token-cache reads) without
+    monkeypatching `builtins.open`. `ops` filters to op-name prefixes
+    (e.g. ("checkpoint",) or ("data",)). Yields {'calls', 'raised'}."""
+    from luminaai_tpu.utils import retry as _retry
+
+    if error_factory is None:
+        def error_factory(op):
+            return _retry.TransientIOError(
+                f"injected transient storage fault ({op})"
+            )
+
+    stats = {"calls": 0, "raised": 0}
+
+    def hook(op: str) -> None:
+        stats["calls"] += 1
+        if ops is not None and not any(op.startswith(p) for p in ops):
+            return
+        if stats["raised"] < times:
+            stats["raised"] += 1
+            raise error_factory(op)
+
+    prev = _retry.set_fault_hook(hook)
+    try:
+        yield stats
+    finally:
+        _retry.set_fault_hook(prev)
+
+
+def bitflip_checkpoint(checkpoint_dir, step: int) -> str:
+    """Flip ONE byte mid-file in the step's largest state file WITHOUT
+    changing its size — silent bit corruption: orbax restores it
+    without complaint, every size check passes, and only the sha256
+    integrity manifest can tell. Returns the damaged file's path;
+    raises if the step (or something to flip) does not exist."""
+    from luminaai_tpu.training.checkpoint import MANIFEST_NAME
+
+    step_dir = Path(checkpoint_dir) / str(step)
+    if not step_dir.is_dir():
+        raise FileNotFoundError(f"no checkpoint step dir {step_dir}")
+    candidates = [
+        f for f in sorted(step_dir.rglob("*"))
+        if f.is_file() and f.name != MANIFEST_NAME
+        and not f.name.endswith(".tmp") and f.stat().st_size > 0
+    ]
+    # Prefer the tensor bytes: a flipped metadata byte often breaks the
+    # parse (loud), a flipped shard byte changes a weight (silent).
+    state_files = [
+        f for f in candidates if "state" in f.relative_to(step_dir).parts
+    ]
+    pool = state_files or candidates
+    if not pool:
+        raise RuntimeError(f"nothing to bitflip under {step_dir}")
+    target = max(pool, key=lambda f: f.stat().st_size)
+    mid = target.stat().st_size // 2
+    with target.open("r+b") as fh:
+        fh.seek(mid)
+        byte = fh.read(1)
+        fh.seek(mid)
+        fh.write(bytes([byte[0] ^ 0xFF]))
+    logger.warning("bitflipped %s at offset %d", target, mid)
+    return str(target)
+
+
+def torn_manifest(checkpoint_dir, step: int) -> str:
+    """Truncate the step's integrity manifest halfway — the torn-write
+    artifact of a writer killed mid-rename-less flush. Verification
+    must classify it as corruption (walk back), never as 'no manifest,
+    proceed unverified'. Returns the manifest path."""
+    from luminaai_tpu.training.checkpoint import MANIFEST_NAME
+
+    m = Path(checkpoint_dir) / str(step) / MANIFEST_NAME
+    if not m.is_file():
+        raise FileNotFoundError(f"no manifest at {m}")
+    data = m.read_bytes()
+    m.write_bytes(data[: max(1, len(data) // 2)])
+    logger.warning("tore manifest %s to %d bytes", m, max(1, len(data) // 2))
+    return str(m)
+
+
+@contextlib.contextmanager
 def slow_decode(decoder, delay_s: float = 0.2) -> Iterator[dict]:
     """Slow/stuck-lane injector: every decode_step stalls `delay_s`, so a
     serving request with a deadline goes overdue mid-decode and the

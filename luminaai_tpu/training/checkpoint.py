@@ -16,9 +16,13 @@ save, history json). Differences by design:
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
+import os
+import random
 import shutil
+import threading
 import time
 from pathlib import Path
 from typing import Any, Dict, List, Optional
@@ -29,8 +33,199 @@ import orbax.checkpoint as ocp
 
 from luminaai_tpu.config import Config
 from luminaai_tpu.monitoring.telemetry import MetricsRegistry, get_registry
+from luminaai_tpu.utils.retry import RetryPolicy
 
 logger = logging.getLogger(__name__)
+
+# -- integrity manifests (docs/resilience.md "Durable I/O") -----------------
+# Every committed step directory carries a per-file sha256 manifest,
+# written atomically (tmp + fsync + rename — the same tamper-evidence
+# discipline as the bench last-good cache). Restore verifies it BEFORE
+# orbax touches the bytes: a bitflipped shard that orbax would happily
+# deserialize into silently-corrupt weights becomes a detected mismatch
+# that `restore_with_fallback` walks past like any other corruption.
+MANIFEST_NAME = "manifest.sha256.json"
+MANIFEST_VERSION = 1
+# Sampled fast mode: hash at most this many files (deterministic choice
+# per step); every file's SIZE is still checked. Trades bitflip coverage
+# for restore latency on multi-TB checkpoints.
+SAMPLE_MAX_HASHED = 4
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """A checkpoint's bytes do not match its integrity manifest (bit
+    corruption, torn write, missing shard). Treated exactly like a
+    corrupt checkpoint: `restore_with_fallback` walks back past it."""
+
+
+def _hash_file(path: Path, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with path.open("rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
+
+
+def _manifest_files(step_dir: Path) -> List[Path]:
+    return [
+        f
+        for f in sorted(step_dir.rglob("*"))
+        if f.is_file()
+        and f.name != MANIFEST_NAME
+        and not f.name.endswith(".tmp")
+    ]
+
+
+def write_manifest(
+    step_dir: Path, retry: Optional[RetryPolicy] = None
+) -> Path:
+    """Hash every committed file under `step_dir` and write the manifest
+    atomically (tmp + fsync + rename): a reader either sees no manifest
+    (pre-manifest legacy / mid-commit) or a complete one — never a torn
+    one that verifies garbage."""
+    step_dir = Path(step_dir)
+    # The hash read-back touches storage file by file: retried too, so
+    # one transient read fault doesn't cost the step its manifest.
+    hash_one = (
+        (lambda f: retry.call(_hash_file, f, op="manifest_write"))
+        if retry is not None
+        else _hash_file
+    )
+    files = {
+        f.relative_to(step_dir).as_posix(): {
+            "sha256": hash_one(f),
+            "size": f.stat().st_size,
+        }
+        for f in _manifest_files(step_dir)
+    }
+    doc = {
+        "version": MANIFEST_VERSION,
+        "algo": "sha256",
+        "created_at": time.time(),
+        "files": files,
+    }
+    payload = json.dumps(doc, indent=1)
+    tmp = step_dir / (MANIFEST_NAME + ".tmp")
+    out = step_dir / MANIFEST_NAME
+
+    def _write():
+        with tmp.open("w") as fh:
+            fh.write(payload)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, out)
+
+    if retry is not None:
+        retry.call(_write, op="manifest_write")
+    else:
+        _write()
+    return out
+
+
+def verify_step_dir(
+    step_dir: Path, mode: str = "full"
+) -> Dict[str, Any]:
+    """Check `step_dir` against its manifest. Returns
+    {"status": "ok"|"corrupt"|"unmanifested", "mode", "files",
+     "hashed", "mismatches": [{"file", "reason"}, ...]}.
+
+    `full` hashes every manifested file; `sample` checks every file's
+    size but hashes only a deterministic per-step subset
+    (SAMPLE_MAX_HASHED) — the fast mode for huge checkpoints. A missing
+    manifest is "unmanifested" (pre-manifest legacy checkpoints restore
+    with a warning, not a failure); an unreadable/torn manifest is
+    "corrupt" (tamper evidence must not be bypassable by damaging the
+    evidence)."""
+    step_dir = Path(step_dir)
+    report: Dict[str, Any] = {
+        "path": str(step_dir),
+        "mode": mode,
+        "files": 0,
+        "hashed": 0,
+        "mismatches": [],
+    }
+    manifest_path = step_dir / MANIFEST_NAME
+    if not manifest_path.is_file():
+        report["status"] = "unmanifested"
+        return report
+    try:
+        doc = json.loads(manifest_path.read_text())
+        files = doc["files"]
+        assert isinstance(files, dict)
+    except Exception as e:
+        report["status"] = "corrupt"
+        report["mismatches"].append(
+            {"file": MANIFEST_NAME, "reason": f"torn_manifest ({e})"}
+        )
+        return report
+    names = sorted(files)
+    report["files"] = len(names)
+    if mode == "sample" and len(names) > SAMPLE_MAX_HASHED:
+        # Deterministic per-directory sample, so repeated verifies of
+        # the same step check the same subset (stable evidence).
+        rnd = random.Random(step_dir.name)
+        to_hash = set(rnd.sample(names, SAMPLE_MAX_HASHED))
+    else:
+        to_hash = set(names)
+    for rel in names:
+        want = files[rel]
+        f = step_dir / rel
+        if not f.is_file():
+            report["mismatches"].append({"file": rel, "reason": "missing"})
+            continue
+        size = f.stat().st_size
+        if size != want.get("size"):
+            report["mismatches"].append(
+                {
+                    "file": rel,
+                    "reason": f"size {size} != {want.get('size')}",
+                }
+            )
+            continue
+        if rel in to_hash:
+            report["hashed"] += 1
+            got = _hash_file(f)
+            if got != want.get("sha256"):
+                report["mismatches"].append(
+                    {"file": rel, "reason": "sha256 mismatch"}
+                )
+    report["status"] = "corrupt" if report["mismatches"] else "ok"
+    return report
+
+
+def verify_checkpoint_dir(
+    root, step: Optional[int] = None, mode: str = "full"
+) -> Dict[str, Any]:
+    """Walk a checkpoint directory's step subdirs and verify each
+    manifest (the `lumina verify-checkpoint` engine — standalone, no
+    orbax manager needed). Returns {"root", "steps": {step: report},
+    "ok", "corrupt", "unmanifested"} with the step lists sorted."""
+    root = Path(root)
+    if not root.is_dir():
+        raise FileNotFoundError(f"no checkpoint directory {root}")
+    steps = sorted(
+        int(p.name) for p in root.iterdir() if p.is_dir() and p.name.isdigit()
+    )
+    if step is not None:
+        if step not in steps:
+            raise FileNotFoundError(f"no step {step} under {root}")
+        steps = [step]
+    out: Dict[str, Any] = {
+        "root": str(root),
+        "mode": mode,
+        "steps": {},
+        "ok": [],
+        "corrupt": [],
+        "unmanifested": [],
+    }
+    for s in steps:
+        report = verify_step_dir(root / str(s), mode=mode)
+        out["steps"][s] = report
+        out[report["status"]].append(s)
+    return out
 
 
 def _is_typed_key(x) -> bool:
@@ -72,13 +267,34 @@ class CheckpointManager:
         config: Config,
         checkpoint_dir: str = "checkpoints",
         registry: Optional[MetricsRegistry] = None,
+        recorder=None,
     ):
         self.config = config
         self.dir = Path(checkpoint_dir).absolute()
         self.dir.mkdir(parents=True, exist_ok=True)
         self.history_file = self.dir / "checkpoint_history.json"
         self.history: List[Dict[str, Any]] = self._load_history()
-        r = registry or get_registry()
+        r = self._registry = registry or get_registry()
+        # None → resolve the process recorder at emit time (tests may
+        # swap it with set_recorder after this manager is built).
+        self._recorder = recorder
+        # Durable I/O (docs/resilience.md "Durable I/O"): every orbax
+        # save/restore and manifest read/write routes through the retry
+        # policy, so a transient storage fault costs a bounded backoff
+        # instead of the run. Call sites sit inside the trainer's open
+        # `checkpoint` goodput region, so retry waits book there.
+        self._retry = RetryPolicy.from_config(
+            config, registry=r, recorder=recorder
+        )
+        # Steps whose async commit may still be in flight: a background
+        # thread writes their manifests once the commit lands (every
+        # exit/restore/next-save path joins it first, so a committed
+        # step never stays manifest-less past the save that follows it).
+        self._pending_manifests: set = set()
+        self._manifest_thread: Optional[threading.Thread] = None
+        # An async commit error caught by the flush thread; re-raised at
+        # the next join so a lost step can never pass silently.
+        self._async_error: Optional[BaseException] = None
         # Resilience counters (docs/resilience.md): restore fallbacks are
         # the "latest checkpoint was corrupt/partial" signal; emergency
         # saves carry a bounded reason label (preemption / non_finite /
@@ -93,6 +309,27 @@ class CheckpointManager:
             "Blocking emergency checkpoints, by (bounded) reason",
             labelnames=("reason",),
         )
+        self._m_manifest = r.counter(
+            "checkpoint_manifest_mismatch_total",
+            "Checkpoints whose bytes failed sha256 manifest verification "
+            "at restore (bit corruption / torn write)",
+        )
+        self._m_unmanifested = r.counter(
+            "checkpoint_unmanifested_restores_total",
+            "Restores of pre-manifest legacy checkpoints (verified by "
+            "orbax parse success only)",
+        )
+        self._m_local_tier = r.counter(
+            "checkpoint_local_tier_saves_total",
+            "Emergency saves that fell back to the local-tier directory "
+            "after the primary checkpoint dir failed",
+        )
+        self._m_failures_commit = r.counter(
+            "io_failures_total",
+            "Storage ops that raised to the caller (permanent error or "
+            "retry ladder exhausted), by op",
+            labelnames=("op",),
+        ).labels(op="checkpoint_commit")
         self.best_loss = min(
             (h["eval_loss"] for h in self.history if h.get("eval_loss") is not None),
             default=float("inf"),
@@ -121,6 +358,13 @@ class CheckpointManager:
         index, shuffle seed, difficulty — dataset state_dict()); it rides
         in the JSON metadata so `trainer.maybe_resume` can fast-forward
         the data stream to the exact batch after this step."""
+        # The previous save's background manifest flush (commit wait +
+        # hash read-back) must finish before orbax starts a new save —
+        # join is a no-op when it already did. The flush running in the
+        # background keeps the hash read-back OFF the train loop, while
+        # a hard crash mid-run still leaves at most ONE step
+        # unmanifested (warn-restore legacy path).
+        self._join_manifest_flush()
         metrics = {
             k: float(v)
             for k, v in (metrics or {}).items()
@@ -142,7 +386,12 @@ class CheckpointManager:
         }
         if data_state is not None:
             meta["data_state"] = data_state
-        saved = self._mngr.save(
+        # Retrying the dispatch is safe against partial attempts: orbax
+        # stages into a `<step>.orbax-checkpoint-tmp-*` dir and renames
+        # only on successful finalize, so a failed attempt leaves no
+        # committed `<step>/` for the re-invocation to collide with.
+        saved = self._retry.call(
+            self._mngr.save,
             step,
             args=ocp.args.Composite(
                 state=ocp.args.StandardSave(saveable),
@@ -150,8 +399,14 @@ class CheckpointManager:
             ),
             metrics=metrics,
             force=force,
+            op="checkpoint_save",
         )
         if saved:
+            # Manifest AFTER the async commit lands: bank the step and
+            # flush it on a background thread (commit wait + hash read-
+            # back overlap training; wait()/the next save joins it).
+            self._pending_manifests.add(step)
+            self._spawn_manifest_flush()
             eval_loss = metrics.get("eval_loss")
             self.history.append(
                 {"step": step, "eval_loss": eval_loss, "time": time.time()}
@@ -162,8 +417,144 @@ class CheckpointManager:
         return saved
 
     def wait(self) -> None:
-        """Block until pending async saves land (call before exit)."""
+        """Block until pending async saves land (call before exit), then
+        write the integrity manifest for every newly committed step."""
+        self._join_manifest_flush()
         self._mngr.wait_until_finished()
+        self._flush_manifests()
+
+    def _spawn_manifest_flush(self) -> None:
+        """Flush pending manifests on a daemon thread: it waits for the
+        async orbax commit, then hashes the committed files — a full
+        read-back that must NOT stall the train loop. Serialized against
+        orbax by construction: the next save()/wait()/restore() joins
+        this thread before touching the manager."""
+        def run():
+            try:
+                # The async orbax commit surfaces ITS write errors here,
+                # not in save() (which only dispatched). Swallowing one
+                # would let the loop continue believing the step landed
+                # — stash it; the next join point re-raises.
+                self._mngr.wait_until_finished()
+            except Exception as e:
+                self._m_failures_commit.inc()
+                self._async_error = e
+                self._emit(
+                    "io_failure", op="checkpoint_commit",
+                    error=f"{type(e).__name__}: {str(e)[:160]}",
+                )
+                logger.error("async checkpoint commit failed: %s", e)
+                return
+            try:
+                self._flush_manifests()
+            except Exception as e:  # evidence never kills training
+                logger.warning("background manifest flush failed: %s", e)
+
+        t = threading.Thread(target=run, daemon=True, name="ckpt-manifest")
+        t.start()
+        self._manifest_thread = t
+
+    def _join_manifest_flush(self) -> None:
+        t = self._manifest_thread
+        if t is not None:
+            t.join()
+            self._manifest_thread = None
+        err, self._async_error = self._async_error, None
+        if err is not None:
+            # A lost async commit is a lost step: surface it where the
+            # caller can act (save_checkpoint raising, or emergency_save
+            # catching and engaging the local tier) — never silently.
+            raise err
+
+    def _flush_manifests(self) -> None:
+        """Hash each banked step's committed files into its manifest.
+        Host 0 only (shared filesystem; mirrors _save_history). A step
+        whose flush fails is RE-banked: a transient hash-time fault must
+        not silently downgrade the checkpoint to warn-only legacy
+        verification forever."""
+        pending, self._pending_manifests = self._pending_manifests, set()
+        if jax.process_index() != 0:
+            return
+        for step in sorted(pending):
+            step_dir = self.dir / str(step)
+            if not step_dir.is_dir():
+                continue  # save failed or the step was rotated out
+            try:
+                write_manifest(step_dir, retry=self._retry)
+            except Exception as e:  # never let evidence cost the save
+                self._pending_manifests.add(step)  # retry at next flush
+                logger.warning(
+                    "manifest write for step %d failed (re-banked): %s",
+                    step, e,
+                )
+
+    def verify_step(
+        self, step: int, mode: Optional[str] = None
+    ) -> Dict[str, Any]:
+        """Manifest verification report for one step (`verify_step_dir`
+        on this manager's layout); mode defaults to
+        config.checkpoint_verify."""
+        mode = mode or getattr(self.config, "checkpoint_verify", "full")
+        return verify_step_dir(self.dir / str(step), mode=mode)
+
+    def _verify_before_restore(self, step: int) -> None:
+        """Integrity gate: raise CheckpointIntegrityError on a manifest
+        mismatch (counted + flight event — restore_with_fallback walks
+        back past it); warn-and-proceed for pre-manifest legacy steps."""
+        mode = getattr(self.config, "checkpoint_verify", "full")
+        if mode == "off":
+            return
+        # EVERY host verifies with the SAME mode: given the same
+        # manifest, the verdict is a pure function of the shared bytes
+        # (sample mode picks its subset deterministically from the step
+        # name), so all hosts agree — a corrupt step makes every host
+        # raise BEFORE any of them enters the orbax restore collective,
+        # and the fallback walk stays in lockstep. A host-0-only gate
+        # would leave the other hosts blocked inside a collective host 0
+        # never joins. The barrier below orders host 0's manifest rename
+        # before the other hosts stat it (a just-flushed rollback
+        # target); residual NFS attribute-cache lag can still downgrade
+        # a non-zero host to the unmanifested warn path — visibility,
+        # not verdict, is the remaining soft spot. Multi-TB checkpoints
+        # bound the N-host hash cost with checkpoint_verify="sample".
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(
+                f"checkpoint_manifest_verify_{step}"
+            )
+        report = self.verify_step(step, mode)
+        if report["status"] == "corrupt":
+            self._m_manifest.inc()
+            self._emit(
+                "manifest_mismatch",
+                step=step,
+                mode=report["mode"],
+                mismatches=report["mismatches"][:8],
+            )
+            raise CheckpointIntegrityError(
+                f"checkpoint step {step} failed manifest verification "
+                f"({len(report['mismatches'])} mismatch(es), first: "
+                f"{report['mismatches'][0]}) — the bytes on disk are not "
+                "the bytes that were saved"
+            )
+        if report["status"] == "unmanifested":
+            self._m_unmanifested.inc()
+            logger.warning(
+                "checkpoint step %d has no integrity manifest "
+                "(pre-manifest legacy): restoring unverified", step,
+            )
+
+    def _emit(self, type: str, **fields) -> None:
+        try:
+            rec = self._recorder
+            if rec is None:
+                from luminaai_tpu.monitoring.events import get_recorder
+
+                rec = get_recorder()
+            rec.emit(type, **fields)
+        except Exception:  # pragma: no cover - telemetry never raises
+            logger.debug("event emit failed", exc_info=True)
 
     # -- restore --------------------------------------------------------
     def restore(self, state, step: Optional[int] = None):
@@ -173,13 +564,19 @@ class CheckpointManager:
             step = self.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {self.dir}")
+        # Flush in-flight commits (and their manifests) first: a mid-run
+        # rollback may restore while the latest save is still landing.
+        self.wait()
+        self._verify_before_restore(step)
         target = {"params": state.params, "opt_state": state.opt_state,
                   "step": state.step, "rng": _rng_to_data(state.rng)}
-        restored = self._mngr.restore(
+        restored = self._retry.call(
+            self._mngr.restore,
             step,
             args=ocp.args.Composite(
                 state=ocp.args.StandardRestore(target)
             ),
+            op="checkpoint_restore",
         )["state"]
         rng = restored["rng"]
         if _is_typed_key(state.rng):
@@ -200,7 +597,10 @@ class CheckpointManager:
         """Restore the newest INTACT checkpoint at or before `step`.
 
         A preemption or disk-full can leave the latest checkpoint
-        truncated; rather than crash the resume, walk back through older
+        truncated — and silent bit corruption leaves one that orbax
+        restores without complaint but whose manifest no longer matches
+        (CheckpointIntegrityError from the pre-restore verify). Either
+        way: rather than crash the resume, walk back through older
         steps until one restores, counting each skip into
         `checkpoint_restore_fallbacks_total`. Returns
         (restored_state, used_step, n_skipped); raises the LAST restore
@@ -237,8 +637,11 @@ class CheckpointManager:
     def load_metadata(self, step: Optional[int] = None) -> Dict[str, Any]:
         if step is None:
             step = self.latest_step()
-        return self._mngr.restore(
-            step, args=ocp.args.Composite(metadata=ocp.args.JsonRestore())
+        return self._retry.call(
+            self._mngr.restore,
+            step,
+            args=ocp.args.Composite(metadata=ocp.args.JsonRestore()),
+            op="checkpoint_restore",
         )["metadata"]
 
     # -- discovery (ref checkpoint.py:178,187,341) -----------------------
@@ -290,7 +693,12 @@ class CheckpointManager:
         move is usually `sys.exit`, and returning while the async orbax
         commit is still in flight would let the exit truncate the very
         checkpoint this exists to protect (contract-tested with an
-        injected exit in tests/test_resilience.py)."""
+        injected exit in tests/test_resilience.py).
+
+        When the primary dir fails (unwritable remount, full disk) and
+        `config.checkpoint_local_tier` names a directory, the save falls
+        back there — losing a preempted run's last step to a storage
+        outage is exactly what a local tier is for."""
         self._m_emergency.labels(reason=_reason_label(reason)).inc()
         ok = False
         try:
@@ -306,11 +714,51 @@ class CheckpointManager:
             except Exception as e:  # pragma: no cover - flush failure
                 logger.error("emergency save flush failed: %s", e)
                 ok = False
+        if not ok:
+            ok = self._emergency_local_tier(state, step, reason, data_state)
         if ok:
             logger.warning(
                 "emergency checkpoint at step %d (%s) committed", step, reason
             )
         return ok
+
+    def _emergency_local_tier(
+        self, state, step: int, reason: str, data_state
+    ) -> bool:
+        """Last-chance fallback: blocking save into the configured
+        local-tier directory after the primary dir failed. Never raises
+        — this runs on the exit path."""
+        tier = getattr(self.config, "checkpoint_local_tier", None)
+        if not tier:
+            return False
+        try:
+            local = CheckpointManager(
+                self.config,
+                str(Path(tier) / self.dir.name),
+                registry=self._registry,
+                recorder=self._recorder,
+            )
+            try:
+                ok = local.save(
+                    state, step, metrics={"emergency": 1.0}, force=True,
+                    data_state=data_state,
+                )
+            finally:
+                local.close()  # blocking flush + manifest
+            if ok:
+                self._m_local_tier.inc()
+                self._emit(
+                    "local_tier_save", step=step, reason=reason,
+                    dir=str(Path(tier) / self.dir.name),
+                )
+                logger.warning(
+                    "emergency save fell back to local tier %s (step %d)",
+                    tier, step,
+                )
+            return ok
+        except Exception as e:
+            logger.error("local-tier emergency save failed: %s", e)
+            return False
 
     # -- history --------------------------------------------------------
     def _load_history(self) -> List[Dict[str, Any]]:

@@ -50,6 +50,7 @@ from luminaai_tpu.parallel.sharding import (
 )
 from luminaai_tpu.parallel.train_step import make_eval_step, make_train_step
 from luminaai_tpu.training.checkpoint import CheckpointManager
+from luminaai_tpu.utils.retry import RetryPolicy, set_default_policy
 from luminaai_tpu.training.optimizer import make_optimizer, make_schedule
 from luminaai_tpu.training.precision import PrecisionManager
 
@@ -209,7 +210,18 @@ class Trainer:
             enabled=config.step_anomaly,
         )
         self.checkpoints = CheckpointManager(
-            config, ckpt_dir, registry=self.registry
+            config, ckpt_dir, registry=self.registry,
+            recorder=self.recorder,
+        )
+        # The trainer owns the process-wide durable-I/O policy while it
+        # lives: data readers without a Config in hand (JsonlIndex /
+        # TokenCache opens) fall back to the default policy, so the
+        # io_retries/io_timeout_s knobs must reach it or they silently
+        # only govern checkpoint I/O. close() restores the previous
+        # policy so a short-lived trainer (tests, tools) doesn't leak
+        # its settings into the rest of the process.
+        self._prev_io_policy = set_default_policy(
+            RetryPolicy.from_config(config, registry=self.registry)
         )
         r = self.registry
         self._m_steps = r.counter(
@@ -1658,3 +1670,4 @@ class Trainer:
             self.watchdog.close()
         self.checkpoints.close()
         self.goodput.stop()
+        set_default_policy(self._prev_io_policy)

@@ -1,0 +1,769 @@
+"""Durable I/O suite (pytest marker: `faults`) — docs/resilience.md
+"Durable I/O".
+
+Proves the storage-fault story is a contract: the retry policy's exact
+backoff/classification/deadline semantics (injected clock+sleep, zero
+wall-clock), flaky-storage training that completes with a loss stream
+bitwise-identical to the fault-free run, sha256 manifest integrity
+(bitflipped latest checkpoint detected at restore and walked back —
+against pre-manifest main that restore SUCCEEDS silently), degraded-mode
+data loading (truncated/corrupt records quarantined, rotten files
+fenced), and the `lumina verify-checkpoint` exit-code contract.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from luminaai_tpu.cli import main as cli_main
+from luminaai_tpu.config import Config
+from luminaai_tpu.data.dataset import (
+    DataCorruptionError,
+    PackedDataset,
+    PrefetchLoader,
+    TokenCache,
+    TokenCacheError,
+    read_jsonl,
+)
+from luminaai_tpu.monitoring.events import FlightRecorder
+from luminaai_tpu.monitoring.telemetry import MetricsRegistry, get_registry
+from luminaai_tpu.testing.faults import (
+    bitflip_checkpoint,
+    flaky_storage,
+    torn_manifest,
+)
+from luminaai_tpu.training.checkpoint import (
+    MANIFEST_NAME,
+    CheckpointIntegrityError,
+    CheckpointManager,
+    verify_checkpoint_dir,
+    verify_step_dir,
+)
+from luminaai_tpu.utils.retry import (
+    RetryPolicy,
+    TransientIOError,
+    default_classify,
+)
+
+pytestmark = pytest.mark.faults
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+class FakeClock:
+    """Injectable clock + sleep recording the exact backoff sequence."""
+
+    def __init__(self):
+        self.t = 0.0
+        self.sleeps = []
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, s):
+        self.sleeps.append(s)
+        self.t += s
+
+
+def mk_policy(registry=None, recorder=None, **kw):
+    clock = FakeClock()
+    kw.setdefault("jitter", 0.0)
+    policy = RetryPolicy(
+        sleep=clock.sleep,
+        clock=clock,
+        registry=registry or MetricsRegistry(),
+        recorder=recorder,
+        **kw,
+    )
+    return policy, clock
+
+
+def failing(times, exc_factory=lambda: TransientIOError("blip")):
+    calls = {"n": 0}
+
+    def fn():
+        calls["n"] += 1
+        if calls["n"] <= times:
+            raise exc_factory()
+        return "ok"
+
+    fn.calls = calls
+    return fn
+
+
+class S:
+    """Minimal TrainState-shaped object for direct CheckpointManager use."""
+
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+    def replace(self, **kw):
+        d = dict(self.__dict__)
+        d.update(kw)
+        return S(**d)
+
+
+def mk_state(v, n=4096):
+    return S(
+        params={"w": np.arange(n, dtype=np.float32) + float(v)},
+        opt_state={"m": np.zeros(8, np.float32)},
+        step=np.asarray(int(v)),
+        rng=np.zeros((2,), np.uint32),
+    )
+
+
+def mk_manager(tmp_path, registry=None, recorder=None, **cfg_kw):
+    reg = registry or MetricsRegistry()
+    cm = CheckpointManager(
+        Config(**cfg_kw), str(tmp_path / "ckpt"), registry=reg,
+        recorder=recorder,
+    )
+    return cm, reg
+
+
+# ---------------------------------------------------------------------------
+# retry policy semantics (injected clock/sleep — no wall-clock)
+# ---------------------------------------------------------------------------
+def test_retry_backoff_sequence_and_counters():
+    reg = MetricsRegistry()
+    rec = FlightRecorder()
+    policy, clock = mk_policy(registry=reg, recorder=rec, max_attempts=4,
+                              base_delay_s=0.05, max_delay_s=2.0)
+    fn = failing(3)
+    assert policy.call(fn, op="checkpoint_save") == "ok"
+    assert fn.calls["n"] == 4
+    # Exponential from base, no jitter: 0.05, 0.1, 0.2.
+    assert clock.sleeps == [0.05, 0.1, 0.2]
+    assert reg.get("io_retries_total").labels(op="checkpoint_save").value == 3
+    assert reg.get("io_failures_total").labels(op="checkpoint_save").value == 0
+    events = rec.snapshot(type="io_retry")
+    assert len(events) == 3
+    assert events[0]["op"] == "checkpoint_save"
+    assert events[0]["attempt"] == 1
+    assert "TransientIOError" in events[0]["error"]
+
+
+def test_retry_delay_caps_at_max():
+    policy, clock = mk_policy(max_attempts=6, base_delay_s=0.5,
+                              max_delay_s=1.0)
+    policy.call(failing(5), op="io")
+    assert clock.sleeps == [0.5, 1.0, 1.0, 1.0, 1.0]
+
+
+def test_permanent_error_never_retries():
+    reg = MetricsRegistry()
+    policy, clock = mk_policy(registry=reg)
+    fn = failing(1, exc_factory=lambda: FileNotFoundError("gone"))
+    with pytest.raises(FileNotFoundError):
+        policy.call(fn, op="data_open")
+    assert fn.calls["n"] == 1 and clock.sleeps == []
+    assert reg.get("io_failures_total").labels(op="data_open").value == 1
+    assert reg.get("io_retries_total").labels(op="data_open").value == 0
+
+
+def test_exhausted_ladder_raises_original():
+    reg = MetricsRegistry()
+    policy, clock = mk_policy(registry=reg, max_attempts=3)
+    fn = failing(99)
+    with pytest.raises(TransientIOError, match="blip"):
+        policy.call(fn, op="io")
+    assert fn.calls["n"] == 3 and len(clock.sleeps) == 2
+    assert reg.get("io_failures_total").labels(op="io").value == 1
+
+
+def test_deadline_cuts_the_ladder_short():
+    # timeout 0.12s: first retry (0.05) fits, the second (0.1 more,
+    # cumulative 0.15) would overrun — fail fast instead of sleeping.
+    policy, clock = mk_policy(max_attempts=10, timeout_s=0.12)
+    fn = failing(99)
+    with pytest.raises(TransientIOError):
+        policy.call(fn, op="io")
+    assert fn.calls["n"] == 2
+    assert clock.sleeps == [0.05]
+
+
+def test_jitter_stays_within_bounds():
+    import random
+
+    policy = RetryPolicy(jitter=0.5, base_delay_s=0.1,
+                         rng=random.Random(7),
+                         registry=MetricsRegistry())
+    delays = [policy.delay_for_attempt(1) for _ in range(200)]
+    assert all(0.05 <= d <= 0.15 for d in delays)
+    assert len(set(round(d, 6) for d in delays)) > 10  # actually jitters
+
+
+def test_default_classification():
+    assert default_classify(TransientIOError("x"))
+    assert default_classify(OSError("io"))
+    assert default_classify(ConnectionError("reset"))
+    assert default_classify(TimeoutError("slow"))
+    assert not default_classify(FileNotFoundError("gone"))
+    assert not default_classify(PermissionError("denied"))
+    assert not default_classify(ValueError("corrupt"))
+    assert not default_classify(KeyError("bug"))
+
+
+def test_flaky_storage_injector_filters_by_op():
+    policy, _ = mk_policy()
+    with flaky_storage(times=2, ops=("data",)) as stats:
+        # checkpoint op passes straight through the hook untouched.
+        assert policy.call(lambda: "x", op="checkpoint_save") == "x"
+        assert stats["raised"] == 0
+        assert policy.call(lambda: "y", op="data_open") == "y"
+        assert stats["raised"] == 2
+    # Hook uninstalled on exit: nothing raised anymore.
+    assert policy.call(lambda: "z", op="data_open") == "z"
+
+
+# ---------------------------------------------------------------------------
+# read_jsonl degraded-mode loading
+# ---------------------------------------------------------------------------
+def _write_jsonl(path, records, tail=b""):
+    with open(path, "wb") as f:
+        for r in records:
+            f.write(json.dumps(r).encode() + b"\n")
+        f.write(tail)
+
+
+def _quarantined(reason):
+    from luminaai_tpu.data.dataset import _quarantine_counter
+
+    return _quarantine_counter().labels(reason=reason).value
+
+
+def test_truncated_trailing_line_skipped_with_counter(tmp_path):
+    """The normal artifact of a preempted writer: the partial record is
+    skipped (counted), the good records still load — this reader used
+    to die on it when the cut landed mid-UTF-8 sequence."""
+    p = tmp_path / "d.jsonl"
+    # Cut INSIDE the multi-byte UTF-8 encoding of 'é' — the worst case:
+    # text-mode iteration raised UnicodeDecodeError before json ran.
+    tail = '{"text": "café"}'.encode("utf-8")[:-3]
+    _write_jsonl(p, [{"text": f"t{i}"} for i in range(3)], tail=tail)
+    before = _quarantined("truncated_tail")
+    recs = list(read_jsonl(str(p)))
+    assert [r["text"] for r in recs] == ["t0", "t1", "t2"]
+    assert _quarantined("truncated_tail") - before == 1
+
+
+def test_truncated_tail_skipped_even_with_quarantine_off(tmp_path):
+    p = tmp_path / "d.jsonl"
+    _write_jsonl(p, [{"a": 1}], tail=b'{"a": 2')
+    assert len(list(read_jsonl(str(p), quarantine=False))) == 1
+
+
+def test_midfile_corruption_quarantined_or_fatal(tmp_path):
+    p = tmp_path / "d.jsonl"
+    with open(p, "wb") as f:
+        f.write(b'{"a": 1}\n')
+        f.write(b'{"a": 2 GARBAGE\n')
+        f.write(b'\xff\xfe not utf8 at all\n')
+        f.write(b'{"a": 3}\n')
+    before = _quarantined("bad_record")
+    recs = list(read_jsonl(str(p)))
+    assert [r["a"] for r in recs] == [1, 3]
+    assert _quarantined("bad_record") - before == 2
+    with pytest.raises(DataCorruptionError, match="data_quarantine"):
+        list(read_jsonl(str(p), quarantine=False))
+
+
+def test_quarantine_rate_fence_aborts(tmp_path):
+    """Past the fence the file is rotten: silently training on the
+    survivors must NOT masquerade as health."""
+    p = tmp_path / "rotten.jsonl"
+    with open(p, "wb") as f:
+        for i in range(30):
+            if i % 3 == 0:
+                f.write(b"NOT JSON\n")
+            else:
+                f.write(json.dumps({"i": i}).encode() + b"\n")
+    with pytest.raises(DataCorruptionError, match="fence"):
+        list(read_jsonl(str(p), max_quarantine_rate=0.05))
+    # A generous fence admits the same file.
+    assert len(list(read_jsonl(str(p), max_quarantine_rate=0.5))) == 20
+
+
+def test_read_jsonl_survives_transient_open_fault(tmp_path):
+    p = tmp_path / "d.jsonl"
+    _write_jsonl(p, [{"a": 1}, {"a": 2}])
+    before = get_registry().get("io_retries_total").labels(
+        op="data_open"
+    ).value
+    with flaky_storage(times=1, ops=("data_open",)) as stats:
+        assert len(list(read_jsonl(str(p)))) == 2
+    assert stats["raised"] == 1
+    after = get_registry().get("io_retries_total").labels(
+        op="data_open"
+    ).value
+    assert after - before >= 1
+
+
+def test_jsonl_index_honors_quarantine_contract(tmp_path):
+    """The mmap-indexed path (streaming shuffled datasets) carries the
+    same degraded-mode contract as read_jsonl: quarantine off makes a
+    corrupt record fatal, and a rotten file trips the rate fence."""
+    from luminaai_tpu.data.dataset import JsonlIndex
+
+    p = tmp_path / "d.jsonl"
+    with open(p, "wb") as f:
+        f.write(b'{"a": 1}\n')
+        f.write(b"GARBAGE\n")
+    idx = JsonlIndex(str(p), quarantine=False)
+    assert idx.record(0) == {"a": 1}
+    with pytest.raises(DataCorruptionError, match="data_quarantine"):
+        idx.record(1)
+    idx.close()
+
+    # A truncated trailing record (preempted writer) is ALWAYS skipped,
+    # never fatal — same contract as read_jsonl.
+    t = tmp_path / "t.jsonl"
+    with open(t, "wb") as f:
+        f.write(b'{"a": 1}\n')
+        f.write(b'{"a": 2')  # cut mid-record, no final newline
+    idx = JsonlIndex(str(t), quarantine=False)
+    assert idx.record(0) == {"a": 1}
+    assert idx.record(1) is None
+    idx.close()
+
+    rotten = tmp_path / "rotten.jsonl"
+    with open(rotten, "wb") as f:
+        for i in range(30):
+            f.write(b"BAD\n" if i % 3 == 0 else
+                    json.dumps({"i": i}).encode() + b"\n")
+    idx = JsonlIndex(str(rotten))
+    with pytest.raises(DataCorruptionError, match="fence"):
+        for i in range(30):
+            idx.record(i)
+    idx.close()
+
+
+def test_blend_shards_honor_quarantine_contract(tmp_path):
+    """Blend-shard reads delegate to read_jsonl, so the third reader
+    carries the same contract: quarantine off makes corruption fatal."""
+    from luminaai_tpu.data.multi_source import MultiSourcePipeline
+
+    p = tmp_path / "a.jsonl"
+    with open(p, "wb") as f:
+        f.write(b'{"text": "ok"}\n')
+        f.write(b"GARBAGE\n")
+    shards = {"a": [str(p)]}
+    strict = MultiSourcePipeline(None, {"a": 1.0}, quarantine=False)
+    with pytest.raises(DataCorruptionError):
+        list(strict.iter_blended(shards, seed=1))
+    lenient = MultiSourcePipeline(None, {"a": 1.0})
+    assert [r["text"] for r in lenient.iter_blended(shards, seed=1)] == ["ok"]
+
+
+# ---------------------------------------------------------------------------
+# TokenCache open-time validation
+# ---------------------------------------------------------------------------
+def _build_cache(tmp_path, n_docs=40):
+    rng = np.random.RandomState(0)
+    docs = [rng.randint(1, 60, size=rng.randint(5, 40)).tolist()
+            for _ in range(n_docs)]
+    return TokenCache(str(tmp_path / "cache")).build(iter(docs))
+
+
+def test_truncated_tokens_file_is_one_actionable_error(tmp_path):
+    cache = _build_cache(tmp_path)
+    size = cache.tokens_path.stat().st_size
+    with cache.tokens_path.open("r+b") as f:
+        f.truncate(size // 2)
+    with pytest.raises(TokenCacheError, match="truncated .tokens.bin"):
+        TokenCache(str(tmp_path / "cache")).open()
+    # The message carries the repair instruction, not a stack of index
+    # errors from deep inside the packer.
+    with pytest.raises(TokenCacheError, match="rebuild"):
+        TokenCache(str(tmp_path / "cache")).open()
+
+
+def test_nonmonotone_offsets_rejected(tmp_path):
+    cache = _build_cache(tmp_path)
+    off = np.load(cache.offsets_path)
+    off[2], off[3] = int(off[3]), int(off[2])  # a decreasing pair
+    np.save(cache.offsets_path, off)
+    with pytest.raises(TokenCacheError, match="monotone"):
+        TokenCache(str(tmp_path / "cache")).open()
+
+
+def test_stale_meta_rejected(tmp_path):
+    cache = _build_cache(tmp_path)
+    meta = json.loads(cache.meta_path.read_text())
+    meta["n_docs"] = meta["n_docs"] + 5
+    cache.meta_path.write_text(json.dumps(meta))
+    with pytest.raises(TokenCacheError, match="stale meta"):
+        TokenCache(str(tmp_path / "cache")).open()
+
+
+def test_valid_cache_opens_and_packs(tmp_path):
+    cache = _build_cache(tmp_path)
+    reopened = TokenCache(str(tmp_path / "cache")).open()
+    ds = PackedDataset(reopened, batch_size=8, seq_length=16,
+                       shuffle_seed=0)
+    batches = list(ds)
+    assert batches and batches[0]["input_ids"].shape == (8, 16)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint integrity manifests
+# ---------------------------------------------------------------------------
+def test_save_writes_manifest_atomically(tmp_path):
+    cm, _ = mk_manager(tmp_path)
+    cm.save(mk_state(1), 1)
+    cm.wait()
+    step_dir = tmp_path / "ckpt" / "1"
+    manifest = step_dir / MANIFEST_NAME
+    assert manifest.is_file()
+    doc = json.loads(manifest.read_text())
+    assert doc["algo"] == "sha256" and doc["files"]
+    # Manifest covers every committed file; no tmp residue.
+    on_disk = {
+        f.relative_to(step_dir).as_posix()
+        for f in step_dir.rglob("*")
+        if f.is_file() and f.name != MANIFEST_NAME
+    }
+    assert set(doc["files"]) == on_disk
+    assert not list(step_dir.rglob("*.tmp"))
+    assert verify_step_dir(step_dir)["status"] == "ok"
+    cm.close()
+
+
+def test_bitflip_detected_and_walked_back(tmp_path):
+    """THE integrity contract: a single flipped byte in the latest
+    checkpoint — which pre-manifest main restores SILENTLY (orbax
+    deserializes corrupt weights without complaint) — is detected at
+    restore and restore_with_fallback lands on the prior good step."""
+    rec = FlightRecorder()
+    cm, reg = mk_manager(tmp_path, recorder=rec)
+    cm.save(mk_state(1), 1)
+    cm.save(mk_state(2), 2)
+    cm.wait()
+    bitflip_checkpoint(tmp_path / "ckpt", 2)
+
+    with pytest.raises(CheckpointIntegrityError):
+        cm.restore(mk_state(0), 2)
+    assert reg.get("checkpoint_manifest_mismatch_total").value >= 1
+    events = rec.snapshot(type="manifest_mismatch")
+    assert events and events[0]["step"] == 2
+
+    restored, used, skipped = cm.restore_with_fallback(mk_state(0))
+    assert used == 1 and skipped == 1
+    np.testing.assert_array_equal(
+        restored.params["w"], mk_state(1).params["w"]
+    )
+    assert reg.get("checkpoint_restore_fallbacks_total").value >= 1
+    cm.close()
+
+
+def test_bitflip_everything_raises_actionable(tmp_path):
+    cm, _ = mk_manager(tmp_path)
+    cm.save(mk_state(1), 1)
+    cm.save(mk_state(2), 2)
+    cm.wait()
+    bitflip_checkpoint(tmp_path / "ckpt", 1)
+    bitflip_checkpoint(tmp_path / "ckpt", 2)
+    with pytest.raises(CheckpointIntegrityError, match="manifest"):
+        cm.restore_with_fallback(mk_state(0))
+    cm.close()
+
+
+def test_legacy_unmanifested_restores_with_warning(tmp_path):
+    """Backward compat pinned: a pre-manifest checkpoint restores (with
+    a warning + counter), never fails on the missing evidence."""
+    cm, reg = mk_manager(tmp_path)
+    cm.save(mk_state(3), 3)
+    cm.wait()
+    (tmp_path / "ckpt" / "3" / MANIFEST_NAME).unlink()
+    restored = cm.restore(mk_state(0), 3)
+    np.testing.assert_array_equal(
+        restored.params["w"], mk_state(3).params["w"]
+    )
+    assert reg.get("checkpoint_unmanifested_restores_total").value == 1
+    assert reg.get("checkpoint_manifest_mismatch_total").value == 0
+    cm.close()
+
+
+def test_torn_manifest_is_corruption_not_legacy(tmp_path):
+    """A torn manifest must read as corruption (walk back) — damaging
+    the evidence cannot bypass the verification."""
+    cm, _ = mk_manager(tmp_path)
+    cm.save(mk_state(1), 1)
+    cm.save(mk_state(2), 2)
+    cm.wait()
+    torn_manifest(tmp_path / "ckpt", 2)
+    report = verify_step_dir(tmp_path / "ckpt" / "2")
+    assert report["status"] == "corrupt"
+    assert "torn_manifest" in report["mismatches"][0]["reason"]
+    _, used, skipped = cm.restore_with_fallback(mk_state(0))
+    assert used == 1 and skipped == 1
+    cm.close()
+
+
+def test_sample_mode_checks_all_sizes(tmp_path):
+    """Sampled fast mode hashes a subset but sizes EVERY file: a
+    truncation anywhere is still caught."""
+    cm, _ = mk_manager(tmp_path, checkpoint_verify="sample")
+    cm.save(mk_state(1), 1)
+    cm.wait()
+    step_dir = tmp_path / "ckpt" / "1"
+    report = verify_step_dir(step_dir, mode="sample")
+    assert report["status"] == "ok"
+    assert report["hashed"] <= 4 < report["files"]
+    target = max(
+        (f for f in step_dir.rglob("*")
+         if f.is_file() and f.name != MANIFEST_NAME),
+        key=lambda f: f.stat().st_size,
+    )
+    with target.open("r+b") as f:
+        f.truncate(target.stat().st_size // 2)
+    report = verify_step_dir(step_dir, mode="sample")
+    assert report["status"] == "corrupt"
+    assert "size" in report["mismatches"][0]["reason"]
+    cm.close()
+
+
+def test_checkpoint_save_restore_survive_flaky_storage(tmp_path):
+    cm, reg = mk_manager(tmp_path)
+    with flaky_storage(times=2, ops=("checkpoint",)) as stats:
+        assert cm.save(mk_state(5), 5)
+        cm.wait()
+    assert stats["raised"] == 2
+    assert reg.get("io_retries_total").labels(
+        op="checkpoint_save"
+    ).value == 2
+    with flaky_storage(times=1, ops=("checkpoint_restore",)):
+        restored = cm.restore(mk_state(0), 5)
+    np.testing.assert_array_equal(
+        restored.params["w"], mk_state(5).params["w"]
+    )
+    assert reg.get("io_retries_total").labels(
+        op="checkpoint_restore"
+    ).value >= 1
+    cm.close()
+
+
+def test_emergency_save_falls_back_to_local_tier(tmp_path):
+    """Primary dir dies mid-run (read-only remount, disk full): the
+    blocking emergency save lands in checkpoint_local_tier instead of
+    losing the preempted run's last step."""
+    tier = tmp_path / "tier"
+    rec = FlightRecorder()
+    cm, reg = mk_manager(
+        tmp_path, recorder=rec, checkpoint_local_tier=str(tier)
+    )
+
+    def broken_save(*a, **k):
+        raise OSError("read-only file system")
+
+    cm.save = broken_save
+    ok = cm.emergency_save(
+        mk_state(7), 7, "sigterm preemption",
+        data_state={"epoch": 0, "batch_index": 7},
+    )
+    assert ok is True
+    assert reg.get("checkpoint_local_tier_saves_total").value == 1
+    assert rec.snapshot(type="local_tier_save")
+    # The tier checkpoint is complete: restorable, manifested, with its
+    # data cursor.
+    tier_cm = CheckpointManager(
+        Config(), str(tier / "ckpt"), registry=MetricsRegistry()
+    )
+    restored = tier_cm.restore(mk_state(0), 7)
+    np.testing.assert_array_equal(
+        restored.params["w"], mk_state(7).params["w"]
+    )
+    assert tier_cm.load_metadata(7)["data_state"]["batch_index"] == 7
+    assert verify_step_dir(tier / "ckpt" / "7")["status"] == "ok"
+    tier_cm.close()
+    cm.close()
+
+
+def test_async_commit_failure_surfaces_at_next_join(tmp_path):
+    """An async orbax commit that fails AFTER save() returned must not
+    vanish into the background flush thread: the next wait()/save()
+    re-raises it (a lost step can never pass silently) and
+    io_failures_total{op=checkpoint_commit} counts it."""
+    import threading
+
+    cm, reg = mk_manager(tmp_path)
+    orig_wait = cm._mngr.wait_until_finished
+    calls = {"raised": 0}
+
+    def flaky_wait():
+        # Fail only the background flush thread's commit wait (orbax's
+        # save() also calls wait_until_finished internally — that one
+        # must pass or the dispatch retry absorbs the injection).
+        if (threading.current_thread().name == "ckpt-manifest"
+                and calls["raised"] == 0):
+            calls["raised"] = 1
+            raise OSError("async commit lost")
+        return orig_wait()
+
+    cm._mngr.wait_until_finished = flaky_wait
+    cm.save(mk_state(1), 1)  # dispatch succeeds; the commit wait fails
+    with pytest.raises(OSError, match="async commit lost"):
+        cm.wait()
+    assert reg.get("io_failures_total").labels(
+        op="checkpoint_commit"
+    ).value == 1
+    cm.wait()  # surfaced once; the manager stays usable
+    cm.close()
+
+
+def test_verify_off_skips_the_gate(tmp_path):
+    cm, reg = mk_manager(tmp_path, checkpoint_verify="off")
+    cm.save(mk_state(1), 1)
+    cm.wait()
+    bitflip_checkpoint(tmp_path / "ckpt", 1)
+    cm.restore(mk_state(0), 1)  # no integrity error: gate disabled
+    assert reg.get("checkpoint_manifest_mismatch_total").value == 0
+    cm.close()
+
+
+# ---------------------------------------------------------------------------
+# lumina verify-checkpoint CLI (exit-code contract)
+# ---------------------------------------------------------------------------
+def test_verify_checkpoint_cli_contract(tmp_path, capsys):
+    cm, _ = mk_manager(tmp_path)
+    cm.save(mk_state(1), 1)
+    cm.save(mk_state(2), 2)
+    cm.wait()
+    cm.close()
+    ckpt = str(tmp_path / "ckpt")
+
+    assert cli_main(["verify-checkpoint", ckpt]) == 0
+    out = capsys.readouterr().out
+    assert "2 ok, 0 corrupt" in out
+
+    assert cli_main(["verify-checkpoint", ckpt, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["ok"] == [1, 2] and not doc["corrupt"]
+
+    bitflip_checkpoint(ckpt, 2)
+    assert cli_main(["verify-checkpoint", ckpt]) == 1
+    out = capsys.readouterr().out
+    assert "corrupt" in out and "sha256 mismatch" in out
+    # Scoped to the intact step: still ok.
+    assert cli_main(["verify-checkpoint", ckpt, "--step", "1"]) == 0
+    assert cli_main(["verify-checkpoint", ckpt, "--step", "2"]) == 1
+    capsys.readouterr()
+
+    # Legacy (no manifest) reports unmanifested, exits 0.
+    (tmp_path / "ckpt" / "1" / MANIFEST_NAME).unlink()
+    assert cli_main(["verify-checkpoint", ckpt, "--step", "1"]) == 0
+    assert "unmanifested" in capsys.readouterr().out
+
+    # Missing dir / step: exit 2 (same contract shape as lumina events).
+    assert cli_main(["verify-checkpoint", str(tmp_path / "nope")]) == 2
+    assert cli_main(["verify-checkpoint", ckpt, "--step", "9"]) == 2
+
+
+def test_verify_checkpoint_cli_sample_mode(tmp_path, capsys):
+    cm, _ = mk_manager(tmp_path)
+    cm.save(mk_state(1), 1)
+    cm.wait()
+    cm.close()
+    assert cli_main(
+        ["verify-checkpoint", str(tmp_path / "ckpt"), "--mode", "sample",
+         "--json"]
+    ) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["mode"] == "sample"
+    report = doc["steps"]["1"]
+    assert report["hashed"] <= 4 <= report["files"]
+
+
+# ---------------------------------------------------------------------------
+# trainer-level acceptance contracts
+# ---------------------------------------------------------------------------
+def tiny_cfg(out, **kw) -> Config:
+    base = dict(
+        vocab_size=64, hidden_size=32, num_layers=1, num_heads=2,
+        num_kv_heads=1, seq_length=16, batch_size=8,
+        use_flash_attention=False, gradient_checkpointing=False,
+        precision="fp32", max_steps=6, eval_every_n_batches=10**6,
+        save_every_n_batches=2, health_check_interval=1000,
+        output_dir=str(out), learning_rate=1e-3,
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+def _packed_loader(cache):
+    ds = PackedDataset(cache, batch_size=8, seq_length=16, shuffle_seed=0)
+    return PrefetchLoader(lambda: iter(ds), prefetch=2, source=ds)
+
+
+def _record_losses(trainer, sink):
+    orig = trainer.train_step
+
+    def wrap(state, batch):
+        out = orig(state, batch)
+        sink.append(float(out[1]["loss"]))
+        return out
+
+    trainer.train_step = wrap
+
+
+def test_flaky_storage_training_is_bitwise_identical(tmp_path):
+    """ACCEPTANCE: transient storage faults on checkpoint saves and data
+    reads cost bounded retries — the run completes, io_retries_total
+    grew, and the loss stream is bitwise-identical to the fault-free
+    run. Storage flakiness must never touch the math."""
+    from luminaai_tpu.training.trainer import Trainer
+
+    cache = _build_cache(tmp_path)
+
+    ref = []
+    ta = Trainer(tiny_cfg(tmp_path / "a"), train_data=_packed_loader(cache),
+                 checkpoint_dir=str(tmp_path / "a" / "ckpt"))
+    _record_losses(ta, ref)
+    sa = ta.train()
+    ta.close()
+    assert sa["final_step"] == 6 and len(ref) == 6
+
+    got = []
+    retries = get_registry().get("io_retries_total")
+    before = sum(c.value for c in retries.children())
+    with flaky_storage(times=2, ops=("data_open",)) as dstats:
+        # The fresh TokenCache re-opens its files THROUGH the faults.
+        loader = _packed_loader(TokenCache(str(tmp_path / "cache")))
+        tb = Trainer(tiny_cfg(tmp_path / "b"), train_data=loader,
+                     checkpoint_dir=str(tmp_path / "b" / "ckpt"))
+    _record_losses(tb, got)
+    with flaky_storage(times=2, ops=("checkpoint",)) as cstats:
+        sb = tb.train()
+    tb.close()
+    after = sum(c.value for c in retries.children())
+
+    assert sb["final_step"] == 6, "flaky storage must not kill the run"
+    assert dstats["raised"] == 2 and cstats["raised"] == 2
+    assert after - before >= 4, "retries must be visible in io_retries_total"
+    assert got == ref, "loss stream must be bitwise-identical"
+
+
+def test_bitflipped_latest_checkpoint_resume_walks_back(tmp_path):
+    """ACCEPTANCE (fails against pre-manifest main, where the bitflipped
+    restore SUCCEEDS with silently corrupt weights): resume detects the
+    flip via the manifest and lands on the prior good step."""
+    from luminaai_tpu.training.trainer import Trainer
+
+    cfg = tiny_cfg(tmp_path, max_steps=4)
+    t = Trainer(cfg, train_data=_packed_loader(_build_cache(tmp_path)),
+                checkpoint_dir=str(tmp_path / "ckpt"))
+    t.train()
+    t.close()
+    assert (tmp_path / "ckpt" / "4").is_dir()
+
+    bitflip_checkpoint(tmp_path / "ckpt", 4)
+    mm = get_registry().get("checkpoint_manifest_mismatch_total")
+    before = mm.value
+    t2 = Trainer(tiny_cfg(tmp_path, max_steps=4),
+                 train_data=_packed_loader(_build_cache(tmp_path)),
+                 checkpoint_dir=str(tmp_path / "ckpt"))
+    assert t2.global_step == 2, "must land on the prior GOOD step"
+    assert mm.value - before >= 1
+    t2.close()
