@@ -544,6 +544,19 @@ def _child_main(name: str) -> None:
             "available": False,
             "reason": "resume check did not produce a ledger",
         }
+        # SLO engine (docs/observability.md "SLOs & burn rate"): the
+        # resumed trainer's objective verdicts + ring sample counts —
+        # proof the retention/judgment layer rides every train process.
+        # Missing verdicts fail the artifact loudly below.
+        slo = resume_check.pop("slo", None)
+        ex["slo"] = (
+            {"available": True, **slo}
+            if isinstance(slo, dict) and slo.get("objectives")
+            else {
+                "available": False,
+                "reason": "resume check produced no slo verdicts",
+            }
+        )
         ex["resume_check"] = resume_check
         ex["bench_gate"] = _gate_verdict(result)
         # Wide-event spine (monitoring/events.py): the bench window
@@ -567,10 +580,19 @@ def _child_main(name: str) -> None:
             "hermetic cpu smoke: attribution + gate + resume surface "
             "check, not a performance claim"
         )
+        # Build identity: the smoke artifact's telemetry must carry the
+        # build_info gauge like every long-lived process.
+        from luminaai_tpu.monitoring.telemetry import register_build_info
+
+        register_build_info(registry, config=cfg)
         # Snapshot again so the decode-cost gauges land in the artifact.
         ex["telemetry"] = registry.snapshot()
         if ex["resumed_exact_data_state"] is not True:
             result["error"] = "resumed_exact_data_state_false"
+        elif not ex["slo"].get("available"):
+            # The SLO surface is an assertion surface like the resume
+            # contract: a smoke artifact without verdicts exits 1.
+            result["error"] = "slo_verdicts_missing"
     if name == "ref_debug_moe":
         result["extras"]["note"] = (
             "reference's own headline benchmark config (debug preset dims, "
@@ -1008,6 +1030,27 @@ def _serve_bench_main(smoke: bool) -> None:
                 result["error"] = "int8_kv_greedy_parity_broken"
             elif not i8_bytes < bf_bytes:
                 result["error"] = "int8_kv_pool_not_smaller"
+
+        # -- SLO engine over the serving registry ----------------------
+        # The retention + judgment layer on the series this bench just
+        # produced (docs/observability.md "SLOs & burn rate"): ring
+        # samples of the serve registry, default serve objectives, one
+        # evaluation — verdicts + ring counts ride the artifact and CI
+        # asserts they exist with valid states.
+        from luminaai_tpu.monitoring.slo import build_slo_stack
+        from luminaai_tpu.monitoring.telemetry import register_build_info
+
+        register_build_info(serve_registry, config=cfg)
+        slo_ring, slo_engine = build_slo_stack(
+            cfg, registry=serve_registry, program="serve",
+        )
+        for _ in range(3):
+            slo_ring.sample_once()  # attached engine evaluates per sample
+        slo_extras = {
+            "available": True,
+            **slo_engine.verdicts(),
+            "ring": slo_ring.stats(),
+        }
         result.update(
             value=round(cont_tps, 1),
             # Baseline for THIS metric is the legacy micro-batched path
@@ -1050,6 +1093,9 @@ def _serve_bench_main(smoke: bool) -> None:
                 # stepwise==generate greedy parity under int8 + the
                 # pool-bytes halving (CI asserts both).
                 "kv_int8": kv_int8,
+                # SLO verdicts + ring sample counts over this bench's
+                # own serving series (CI asserts presence/states).
+                "slo": slo_extras,
                 # Registry snapshot: TTFT / per-token / queue-wait
                 # histograms and KV-pool occupancy, embedded so the
                 # serving perf claim carries its own telemetry
@@ -1068,6 +1114,12 @@ def _serve_bench_main(smoke: bool) -> None:
         # one means the scheduler ran uninstrumented — fail loudly
         # rather than quietly shipping an unverifiable number.
         result["error"] = "telemetry_snapshot_missing"
+    if "error" not in result and not (
+        result.get("extras", {}).get("slo", {}).get("objectives")
+    ):
+        # Same contract for the SLO surface: a serve artifact without
+        # objective verdicts means the retention/judgment layer broke.
+        result["error"] = "slo_verdicts_missing"
     print(json.dumps(result), flush=True)
     if "error" in result:
         sys.exit(1)
@@ -1540,6 +1592,11 @@ def _smoke_resume_check() -> dict:
             # extras.goodput; CI asserts fraction in (0, 1] and the
             # cause partition complete.
             "goodput": s2.get("goodput"),
+            # SLO engine verdicts + ring sample counts from the resumed
+            # trainer (docs/observability.md "SLOs & burn rate"). Lifted
+            # into extras.slo; CI asserts verdicts present with valid
+            # states and the ring actually sampled.
+            "slo": s2.get("slo"),
         }
     except Exception as e:  # the artifact must stay parseable
         return {

@@ -64,6 +64,8 @@ def _apply_overrides(cfg, args) -> None:
         ("watchdog", "watchdog"),
         ("watchdog_k", "watchdog_k"),
         ("watchdog_floor", "watchdog_floor_s"),
+        ("slo", "slo"),
+        ("slo_config", "slo_config"),
     ]:
         val = getattr(args, flag, None)
         if val is not None:
@@ -642,6 +644,16 @@ def cmd_serve(args) -> int:
             print("--secure with no --user/--password and no existing "
                   "users.json: nobody could authenticate", file=sys.stderr)
             return 2
+    stale_after = getattr(args, "healthz_stale_after", None)
+    if stale_after is not None and stale_after <= 0:
+        # Mirrors the --latency-buckets pattern: die with exit 2 NOW,
+        # not a ValueError after minutes of checkpoint load.
+        print(
+            f"--healthz-stale-after needs a positive number of seconds, "
+            f"got {stale_after!r}",
+            file=sys.stderr,
+        )
+        return 2
     buckets = None
     raw_buckets = getattr(args, "latency_buckets", None)
     if raw_buckets:
@@ -699,6 +711,9 @@ def cmd_serve(args) -> int:
         watchdog_abort=getattr(args, "watchdog_abort", False),
         watchdog_k=getattr(args, "watchdog_k", None),
         watchdog_floor_s=getattr(args, "watchdog_floor", None),
+        slo=not getattr(args, "no_slo", False),
+        slo_config=getattr(args, "slo_config", None),
+        healthz_stale_after_s=getattr(args, "healthz_stale_after", None),
     )
     return 0
 
@@ -1192,10 +1207,14 @@ def cmd_events(args) -> int:
         since=since,
         tail=args.tail if args.tail else None,
     )
-    if getattr(args, "stats", False):
-        stats = events_stats(events)
+    if getattr(args, "stats", False) or getattr(args, "stats_by", None):
+        # --by implies --stats (a grouping axis only means something for
+        # the summary form).
+        stats = events_stats(events, by=getattr(args, "stats_by", None))
         if args.json:
             print(json.dumps(stats, default=str))
+        elif stats.get("by"):
+            _print_grouped_stats(stats)
         else:
             import time as _time
 
@@ -1237,6 +1256,180 @@ def cmd_events(args) -> int:
         file=sys.stderr,
     )
     return 0
+
+
+def _print_grouped_stats(stats: Dict[str, Any]) -> None:
+    """`lumina events --stats --by tenant|request` table: biggest
+    burners first, each with its rate and top event types."""
+    import time as _time
+
+    def _fmt_ts(ts):
+        if not isinstance(ts, (int, float)):
+            return "?"
+        return _time.strftime("%H:%M:%S", _time.localtime(ts))
+
+    print(
+        f"{stats['total']} event(s) spanning {stats['span_s']}s, "
+        f"grouped by {stats['by']}"
+    )
+    header = (
+        f"{stats['by']:<26}{'count':>8}{'rate/s':>10}  "
+        f"first .. last  top types"
+    )
+    print(header)
+    print("-" * len(header))
+    for key, rec in stats["groups"].items():
+        rate = (
+            f"{rec['rate_per_s']:.3f}"
+            if rec["rate_per_s"] is not None
+            else "-"
+        )
+        top = ", ".join(
+            f"{t}={n}"
+            for t, n in sorted(
+                rec["by_type"].items(), key=lambda kv: (-kv[1], kv[0])
+            )[:3]
+        )
+        print(
+            f"{key:<26}{rec['count']:>8}{rate:>10}  "
+            f"{_fmt_ts(rec['first_ts'])} .. {_fmt_ts(rec['last_ts'])}  "
+            f"{top}"
+        )
+
+
+def _top_sources(args):
+    """Resolve `lumina top`'s data source into (fetch_fn, source_label).
+
+    fetch_fn() -> (history_dict, slo_dict_or_None). Exit-2 errors raise
+    SystemExit here so the caller stays flat."""
+    import urllib.error
+    import urllib.request
+
+    from luminaai_tpu.monitoring.timeseries import (
+        get_history,
+        latest_history_dump,
+        load_history,
+    )
+
+    url = getattr(args, "url", None)
+    path = getattr(args, "source", None)
+    if url:
+        base = url.rstrip("/")
+
+        def fetch_url():
+            with urllib.request.urlopen(
+                f"{base}/metrics/history", timeout=10
+            ) as r:
+                history = json.loads(r.read())
+            slo = None
+            try:
+                with urllib.request.urlopen(f"{base}/slo", timeout=10) as r:
+                    slo = json.loads(r.read())
+            except urllib.error.HTTPError:
+                pass  # SLO engine disabled server-side: history-only view
+            return history, slo
+
+        return fetch_url, base
+    if path:
+        resolved = path
+        if os.path.isdir(path):
+            resolved = latest_history_dump(path)
+            if resolved is None:
+                print(f"no tshist-*.json dumps under {path}",
+                      file=sys.stderr)
+                raise SystemExit(2)
+        if not os.path.exists(resolved):
+            print(f"no such history dump: {resolved}", file=sys.stderr)
+            raise SystemExit(2)
+
+        def fetch_file(resolved=resolved):
+            try:
+                doc = load_history(resolved)
+            except (ValueError, json.JSONDecodeError) as e:
+                print(f"bad history dump {resolved}: {e}", file=sys.stderr)
+                raise SystemExit(2)
+            # Dumps written by a live SLO engine embed the verdict table
+            # so the post-mortem view matches the live one.
+            return doc, doc.get("slo")
+
+        return fetch_file, resolved
+
+    def fetch_live():
+        ring = get_history()
+        if ring is None:
+            print(
+                "no live history ring in this process (start a trainer/"
+                "server with SLO on, or pass a dump path / --url)",
+                file=sys.stderr,
+            )
+            raise SystemExit(2)
+        # Read-only attach: sampling here would split counter deltas
+        # into refresh-sized intervals AND fire any attached SLO
+        # engine's evaluation — viewing the dashboard must never skew
+        # the data or advance the alert state machine. Between-tick
+        # staleness (≤ one sample interval) is the honest trade. The
+        # engine advertised on the ring supplies the verdict table from
+        # its CACHED last evaluation (no state advance).
+        engine = getattr(ring, "slo", None)
+        return ring.snapshot(), (
+            engine.verdicts() if engine is not None else None
+        )
+
+    return fetch_live, "<live ring>"
+
+
+def cmd_top(args) -> int:
+    """Live operator dashboard over the time-series ring
+    (docs/observability.md "SLOs & burn rate"): sparklines for
+    throughput/latency/occupancy, per-tenant top-K, and the SLO
+    burn-rate verdict table. Sources: --url against a serving process
+    (GET /metrics/history + /slo), a tshist-*.json dump (or a directory
+    holding them), or — with neither — this process's live ring.
+    --once renders a single frame; --json emits the machine form."""
+    from luminaai_tpu.monitoring.top import render_top, top_payload
+
+    try:
+        fetch, source = _top_sources(args)
+    except SystemExit as e:
+        return int(e.code or 2)
+
+    def frame():
+        try:
+            history, slo = fetch()
+        except SystemExit as e:  # bad dump discovered on read
+            raise
+        except Exception as e:
+            print(f"fetch failed: {e}", file=sys.stderr)
+            raise SystemExit(2)
+        if args.json:
+            return json.dumps(
+                top_payload(
+                    history, slo,
+                    window_s=args.window, top_k=args.top_k,
+                ),
+                default=str,
+            )
+        return render_top(
+            history, slo, source=source,
+            window_s=args.window, top_k=args.top_k,
+        )
+
+    try:
+        if args.once or args.json:
+            print(frame())
+            return 0
+        import time as _time
+
+        while True:  # refresh loop; ^C exits
+            out = frame()
+            # ANSI clear + home keeps the frame in place like top(1).
+            sys.stdout.write("\x1b[2J\x1b[H" + out)
+            sys.stdout.flush()
+            _time.sleep(max(0.2, float(args.interval)))
+    except KeyboardInterrupt:
+        return 0
+    except SystemExit as e:
+        return int(e.code or 2)
 
 
 def cmd_verify_checkpoint(args) -> int:
@@ -1448,6 +1641,20 @@ def build_parser() -> argparse.ArgumentParser:
             help="minimum stall seconds before the watchdog can fire "
                  "(default 30)",
         )
+        so = sp.add_argument_group(
+            "SLO engine (docs/observability.md 'SLOs & burn rate')"
+        )
+        so.add_argument(
+            "--slo", dest="slo",
+            action=argparse.BooleanOptionalAction, default=None,
+            help="windowed history ring + burn-rate alerts over the "
+                 "default train objectives (default: on)",
+        )
+        so.add_argument(
+            "--slo-config", dest="slo_config",
+            help="JSON file REPLACING the default objectives "
+                 "(docs/observability.md lists the schema)",
+        )
         par = sp.add_argument_group("parallelism (docs/parallelism.md)")
         par.add_argument("--dp", type=int, help="data axis (-1 = auto)")
         par.add_argument(
@@ -1641,6 +1848,21 @@ def build_parser() -> argparse.ArgumentParser:
                          "watchdog can fire (default 30; raise above "
                          "your worst-case decode compile before "
                          "enabling --watchdog-abort)")
+    sv.add_argument("--no-slo", dest="no_slo", action="store_true",
+                    help="disable the history ring + SLO burn-rate "
+                         "engine (GET /slo and /metrics/history then "
+                         "answer 404)")
+    sv.add_argument("--slo-config", dest="slo_config",
+                    help="JSON file REPLACING the default serve "
+                         "objectives (docs/observability.md 'SLOs & "
+                         "burn rate')")
+    sv.add_argument("--healthz-stale-after", dest="healthz_stale_after",
+                    type=float, default=None,
+                    help="seconds since the last decode tick (while "
+                         "busy) or train step after which /healthz "
+                         "reports status=degraded (still 200) so "
+                         "probes catch wedged-but-alive processes "
+                         "before the watchdog aborts")
     sv.set_defaults(fn=cmd_serve)
 
     b = sub.add_parser("benchmark", help="run the bench harness")
@@ -1743,10 +1965,41 @@ def build_parser() -> argparse.ArgumentParser:
                     help="summarize instead of listing: count + rate per "
                          "event type, first/last timestamps (applies "
                          "after the other filters)")
+    ev.add_argument("--by", dest="stats_by", choices=("tenant", "request"),
+                    help="with --stats: group the summary by identity — "
+                         "per-tenant (or per-request) counts, rates and "
+                         "type breakdowns, biggest burners first")
     ev.add_argument("--json", action="store_true",
                     help="one JSON record per line (pipe into jq); with "
                          "--stats, the summary as one JSON object")
     ev.set_defaults(fn=cmd_events)
+
+    tp = sub.add_parser(
+        "top",
+        help="live operator dashboard over the time-series ring "
+             "(sparklines + SLO burn-rate table)",
+    )
+    tp.add_argument(
+        "source", nargs="?",
+        help="tshist-*.json history dump, or a directory holding them "
+             "(e.g. a checkpoint dir); default: this process's live ring",
+    )
+    tp.add_argument("--url",
+                    help="attach to a serving process instead: polls "
+                         "GET /metrics/history + /slo (e.g. "
+                         "http://127.0.0.1:5001)")
+    tp.add_argument("--once", action="store_true",
+                    help="render one frame and exit (scripts, tests)")
+    tp.add_argument("--json", action="store_true",
+                    help="machine form of the frame (implies --once)")
+    tp.add_argument("--interval", type=float, default=2.0,
+                    help="refresh seconds for the live view (default 2)")
+    tp.add_argument("--window", type=float, default=None,
+                    help="restrict rows/tenant sums to the last N "
+                         "seconds (default: everything retained)")
+    tp.add_argument("--top-k", dest="top_k", type=int, default=4,
+                    help="tenants shown in the top-K table (default 4)")
+    tp.set_defaults(fn=cmd_top)
 
     vc = sub.add_parser(
         "verify-checkpoint",

@@ -364,6 +364,31 @@ class Config:
     # (no gauges, no events).
     step_anomaly: bool = True
     step_anomaly_k: float = 4.0
+    # --- SLO engine (docs/observability.md "SLOs & burn rate") ---
+    # A background sampler retains windowed history of the registry in a
+    # fixed-memory ring (counters as deltas, histograms as windowed
+    # quantiles) and the SLO engine judges declarative objectives over
+    # fast/slow windows with Google-SRE burn-rate rules: a fast-window
+    # burn >= slo_fast_burn pages, a slow-window burn >= slo_slow_burn
+    # warns, transitions land in the flight recorder as slo_burn events.
+    # slo_config points at a JSON file REPLACING the default objectives.
+    # All host-side: zero new syncs on the step path.
+    slo: bool = True
+    slo_sample_interval_s: float = 5.0
+    slo_ring_points: int = 720       # per series (~1h at the default 5s)
+    slo_max_series: int = 256        # hard series budget (then _overflow)
+    slo_fast_window_s: float = 60.0
+    slo_slow_window_s: float = 600.0
+    slo_fast_burn: float = 10.0
+    slo_slow_burn: float = 2.0
+    slo_budget: float = 0.1          # allowed violating-sample fraction
+    # Default objective targets (objectives_for builds them from these):
+    slo_ttft_p95_s: float = 2.0      # serve: p95 time-to-first-token
+    slo_decode_p50_s: float = 0.5    # serve: median per-token latency
+    slo_error_rate: float = 0.05     # serve: shed+timeout / admissions
+    slo_goodput_fraction: float = 0.5  # train: productive/elapsed floor
+    slo_step_time_factor: float = 2.0  # train: p95 vs rolling median
+    slo_config: Optional[str] = None   # JSON override (--slo-config)
     # --- Durable I/O (docs/resilience.md "Durable I/O") ---
     # Storage ops (checkpoint save/restore, manifest writes, data opens/
     # reads) retry transient faults with exponential backoff + jitter:
@@ -542,6 +567,28 @@ class Config:
         assert self.watchdog_warmup >= 1, "watchdog_warmup must be >= 1"
         assert self.watchdog_poll_s > 0, "watchdog_poll_s must be positive"
         assert self.step_anomaly_k > 1, "step_anomaly_k must be > 1"
+        assert self.slo_sample_interval_s > 0, (
+            "slo_sample_interval_s must be positive"
+        )
+        assert self.slo_ring_points >= 2, "slo_ring_points must be >= 2"
+        assert self.slo_max_series >= 1, "slo_max_series must be >= 1"
+        assert 0 < self.slo_fast_window_s < self.slo_slow_window_s, (
+            "slo windows must satisfy 0 < fast < slow"
+        )
+        assert self.slo_fast_burn >= 1, "slo_fast_burn must be >= 1"
+        assert self.slo_slow_burn >= 1, "slo_slow_burn must be >= 1"
+        assert 0 < self.slo_budget <= 1, "slo_budget must be in (0, 1]"
+        assert self.slo_ttft_p95_s > 0, "slo_ttft_p95_s must be positive"
+        assert self.slo_decode_p50_s > 0, "slo_decode_p50_s must be positive"
+        assert 0 < self.slo_error_rate <= 1, (
+            "slo_error_rate must be in (0, 1]"
+        )
+        assert 0 < self.slo_goodput_fraction <= 1, (
+            "slo_goodput_fraction must be in (0, 1]"
+        )
+        assert self.slo_step_time_factor > 1, (
+            "slo_step_time_factor must be > 1"
+        )
         assert self.io_retries >= 1, "io_retries must be >= 1 (1 = no retry)"
         assert self.io_retry_base_s > 0, "io_retry_base_s must be positive"
         assert self.io_retry_max_s >= self.io_retry_base_s, (

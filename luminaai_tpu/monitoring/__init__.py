@@ -29,11 +29,27 @@ from luminaai_tpu.monitoring.logger import (
     TrainingAlert,
     TrainingHealthMonitor,
 )
+from luminaai_tpu.monitoring.slo import (
+    Objective,
+    SLOEngine,
+    build_slo_stack,
+    default_serve_objectives,
+    default_train_objectives,
+    load_slo_config,
+    objectives_for,
+)
 from luminaai_tpu.monitoring.telemetry import (
     DEFAULT_LATENCY_BUCKETS,
     MetricsRegistry,
     get_registry,
+    register_build_info,
     set_registry,
+)
+from luminaai_tpu.monitoring.timeseries import (
+    TimeSeriesRing,
+    get_history,
+    load_history,
+    set_history,
 )
 from luminaai_tpu.monitoring.tracing import NULL_TRACER, Span, SpanTracer
 
@@ -54,6 +70,18 @@ __all__ = [
     "DEFAULT_LATENCY_BUCKETS",
     "get_registry",
     "set_registry",
+    "register_build_info",
+    "Objective",
+    "SLOEngine",
+    "build_slo_stack",
+    "default_serve_objectives",
+    "default_train_objectives",
+    "load_slo_config",
+    "objectives_for",
+    "TimeSeriesRing",
+    "get_history",
+    "set_history",
+    "load_history",
     "SpanTracer",
     "Span",
     "NULL_TRACER",

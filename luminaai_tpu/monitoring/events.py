@@ -62,6 +62,7 @@ __all__ = [
     "events_stats",
     "parse_since",
     "DUMP_PREFIX",
+    "STATS_BY_FIELDS",
 ]
 
 # Bump when the envelope (v/seq/ts/type) changes shape; producers adding
@@ -293,11 +294,27 @@ def parse_since(spec: str, now: Optional[float] = None) -> float:
     return ts
 
 
-def events_stats(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+# `--stats --by <axis>` grouping axes -> the record field they key on.
+STATS_BY_FIELDS = {"tenant": "tenant", "request": "request_id"}
+
+
+def events_stats(
+    events: Iterable[Dict[str, Any]], by: Optional[str] = None
+) -> Dict[str, Any]:
     """`lumina events --stats`: per-type counts and rates plus the
     first/last timestamps — a dump or live ring summarized without
     scrolling it. Rates use the OVERALL observed span (last - first ts)
-    so per-type numbers are comparable on one denominator."""
+    so per-type numbers are comparable on one denominator.
+
+    With `by` ("tenant" | "request"), adds a `groups` breakdown keyed by
+    that identity field (events without it pool under "-"), each group
+    carrying its own count/rate/first/last plus per-type counts — so a
+    forensic dump answers "which tenant was burning the error budget"
+    without jq gymnastics."""
+    if by is not None and by not in STATS_BY_FIELDS:
+        raise ValueError(
+            f"unknown --by axis {by!r} (one of {sorted(STATS_BY_FIELDS)})"
+        )
     events = list(events)
     ts = [
         e["ts"] for e in events if isinstance(e.get("ts"), (int, float))
@@ -322,13 +339,45 @@ def events_stats(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
         rec["rate_per_s"] = (
             round(rec["count"] / span, 4) if span > 0 else None
         )
-    return {
+    out = {
         "total": len(events),
         "first_ts": first,
         "last_ts": last,
         "span_s": round(span, 3) if ts else 0.0,
         "by_type": dict(sorted(by_type.items())),
     }
+    if by is not None:
+        field = STATS_BY_FIELDS[by]
+        groups: Dict[str, Dict[str, Any]] = {}
+        for e in events:
+            key = str(e.get(field) or "-")
+            rec = groups.setdefault(
+                key,
+                {
+                    "count": 0, "first_ts": None, "last_ts": None,
+                    "by_type": {},
+                },
+            )
+            rec["count"] += 1
+            t = str(e.get("type", "?"))
+            rec["by_type"][t] = rec["by_type"].get(t, 0) + 1
+            ets = e.get("ts")
+            if isinstance(ets, (int, float)):
+                if rec["first_ts"] is None or ets < rec["first_ts"]:
+                    rec["first_ts"] = ets
+                if rec["last_ts"] is None or ets > rec["last_ts"]:
+                    rec["last_ts"] = ets
+        for rec in groups.values():
+            rec["rate_per_s"] = (
+                round(rec["count"] / span, 4) if span > 0 else None
+            )
+            rec["by_type"] = dict(sorted(rec["by_type"].items()))
+        out["by"] = by
+        # Biggest burners first: the question this axis exists to answer.
+        out["groups"] = dict(
+            sorted(groups.items(), key=lambda kv: (-kv[1]["count"], kv[0]))
+        )
+    return out
 
 
 def format_event(ev: Dict[str, Any]) -> str:

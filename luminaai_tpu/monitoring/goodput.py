@@ -195,6 +195,16 @@ class GoodputLedger:
     def elapsed(self) -> float:
         return self._totals_elapsed_locked()[1]
 
+    def current_cause(self) -> Optional[str]:
+        """The cause accruing right now (None when disabled/stopped) —
+        lets liveness surfaces distinguish 'not advancing because
+        wedged' from 'not advancing because legitimately inside an
+        eval/checkpoint window'."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            return self._cause
+
     def seconds(self) -> Dict[str, float]:
         """Per-cause totals INCLUDING the open segment's live accrual,
         so the partition identity holds at any instant."""

@@ -46,6 +46,8 @@ __all__ = [
     "MetricsRegistry",
     "get_registry",
     "set_registry",
+    "register_build_info",
+    "BUILD_INFO_SCHEMA_VERSION",
     "DEFAULT_LATENCY_BUCKETS",
     "DEFAULT_MAX_LABEL_VALUES",
     "MAX_LABEL_VALUE_LEN",
@@ -561,6 +563,96 @@ def weak_callback(
         return read(o)
 
     return call
+
+
+# -- build info (fleet debugging) ----------------------------------------
+# Bump when the exposition/event envelope contracts change together; the
+# build_info gauge carries it so a fleet scrape can spot version skew.
+BUILD_INFO_SCHEMA_VERSION = 1
+
+_git_commit_cache: Optional[str] = None
+
+
+def _git_commit() -> str:
+    """Best-effort short commit id: CI env vars first, then one cached
+    `git rev-parse` (never raises — 'unknown' beats a crashed startup)."""
+    global _git_commit_cache
+    if _git_commit_cache is not None:
+        return _git_commit_cache
+    import os
+
+    commit = os.environ.get("GIT_COMMIT") or os.environ.get("GITHUB_SHA")
+    if not commit:
+        import subprocess
+
+        try:
+            commit = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                capture_output=True, text=True, timeout=5,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            ).stdout.strip()
+        except Exception:
+            commit = ""
+    _git_commit_cache = (commit or "unknown")[:12]
+    return _git_commit_cache
+
+
+def config_digest(config: Any) -> str:
+    """Short stable hash of a Config (or any to_dict-able / dict /
+    string) so two processes can be compared for config skew without
+    shipping the whole config through labels."""
+    import hashlib
+    import json as _json
+
+    if config is None:
+        return "none"
+    if hasattr(config, "to_dict"):
+        config = config.to_dict()
+    try:
+        blob = _json.dumps(config, sort_keys=True, default=str)
+    except (TypeError, ValueError):
+        blob = str(config)
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+def register_build_info(registry=None, config=None) -> Dict[str, str]:
+    """Register the `build_info` gauge (value 1, identity in labels):
+    git commit, jax/jaxlib versions, config hash, schema version — the
+    standard fleet-debugging series ("which replicas run which build").
+    Called at process start by the trainer, the serving server and the
+    bench children; idempotent per label set. Returns the label dict."""
+    if registry is None:
+        registry = get_registry()
+    try:  # telemetry itself must stay importable without jax
+        import jax
+
+        jax_v = getattr(jax, "__version__", "unknown")
+    except Exception:
+        jax_v = "unavailable"
+    try:
+        import jaxlib
+
+        jaxlib_v = getattr(jaxlib, "__version__", "unknown")
+    except Exception:
+        jaxlib_v = "unavailable"
+    labels = {
+        "git_commit": _git_commit(),
+        "jax": str(jax_v),
+        "jaxlib": str(jaxlib_v),
+        "config_hash": config_digest(config),
+        "schema": str(BUILD_INFO_SCHEMA_VERSION),
+    }
+    registry.gauge(
+        "build_info",
+        "Process build identity (value is always 1; the labels are the "
+        "payload): git commit, jax/jaxlib versions, config hash, "
+        "schema version",
+        labelnames=tuple(sorted(labels)),
+        # A process registers a handful of identities (trainer + server
+        # colocated, a few configs in tests) — small bounded budget.
+        max_label_values=16,
+    ).labels(**labels).set(1)
+    return labels
 
 
 # -- process-wide default sink ------------------------------------------

@@ -51,6 +51,7 @@ import contextlib
 import inspect
 import json
 import logging
+import math
 import queue
 import threading
 import time
@@ -59,11 +60,18 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
 from luminaai_tpu.monitoring.events import FlightRecorder, get_recorder
+from luminaai_tpu.monitoring.slo import SLOEngine, build_slo_stack
+from luminaai_tpu.monitoring.timeseries import (
+    TimeSeriesRing,
+    get_history,
+    set_history,
+)
 from luminaai_tpu.monitoring.watchdog import HangWatchdog, StepTimeSentinel
 from luminaai_tpu.monitoring.telemetry import (
     DEFAULT_LATENCY_BUCKETS,
     MetricsRegistry,
     get_registry,
+    register_build_info,
     weak_callback,
 )
 from luminaai_tpu.monitoring.tracing import NULL_TRACER, SpanTracer
@@ -379,6 +387,10 @@ class ContinuousScheduler:
         self.recorder = recorder if recorder is not None else get_recorder()
         self.max_tenants = max(1, int(max_tenants))
         self.tick_every = max(1, int(tick_every))
+        # Liveness stamp for /healthz staleness: wall ts of the last
+        # completed decode step. None until the first tick (an idle
+        # scheduler is not stale — only a busy one that stopped ticking).
+        self.last_tick_ts: Optional[float] = None
         self._init_telemetry(registry, tracer, telemetry, latency_buckets)
         self._worker = threading.Thread(target=self._loop, daemon=True)
         self._worker.start()
@@ -1042,6 +1054,11 @@ class ContinuousScheduler:
                 with self.tracer.span("prefill_chunk", slot=slot):
                     info = self.decoder.advance_prefill(st)
                 spent += time.perf_counter() - t_chunk
+                # A chunk advance is real progress: stamp liveness here
+                # too, or a prefill-only window (huge prompt, no active
+                # decode lanes) would read as stale to /healthz while
+                # the scheduler is genuinely working.
+                self.last_tick_ts = time.time()
             except Exception as e:
                 logger.exception("chunked prefill failed")
                 self._release_slot(slot)
@@ -1189,6 +1206,7 @@ class ContinuousScheduler:
                 return
             if self.watchdog is not None:
                 self.watchdog.beat()
+            self.last_tick_ts = time.time()
             n_produced = sum(1 for slot in active if produced[slot])
             if self.telemetry:
                 self._m_step.observe(step_dt)
@@ -1316,6 +1334,9 @@ class ChatServer:
         watchdog_abort: bool = False,
         watchdog_k: Optional[float] = None,
         watchdog_floor_s: Optional[float] = None,
+        slo: bool = True,
+        slo_config: Optional[str] = None,
+        healthz_stale_after_s: Optional[float] = None,
     ):
         self.engine = engine
         self.telemetry = bool(telemetry)
@@ -1405,6 +1426,48 @@ class ChatServer:
                 engine, max_batch=max_batch, window_ms=batch_window_ms,
                 recorder=self.recorder, telemetry=telemetry,
             )
+        # Build identity for fleet debugging (docs/observability.md):
+        # which commit/jax/config answers this /metrics.
+        register_build_info(self.registry, config=engine.config)
+        # /healthz staleness: a wedged-but-alive process (decode loop
+        # stuck inside a sync) keeps answering probes — with a stale
+        # threshold set, a busy scheduler whose last decode tick is
+        # older than this flips status to "degraded" (still 200) so
+        # external probes catch it before the watchdog aborts.
+        if healthz_stale_after_s is not None and not (
+            float(healthz_stale_after_s) > 0
+        ):
+            # A falsy-zero check here would silently DISABLE the probe
+            # the flag exists for; reject loudly instead.
+            raise ValueError(
+                "healthz_stale_after_s must be positive, got "
+                f"{healthz_stale_after_s!r}"
+            )
+        self.healthz_stale_after_s = (
+            float(healthz_stale_after_s)
+            if healthz_stale_after_s is not None
+            else None
+        )
+        # SLO layer (docs/observability.md "SLOs & burn rate"): windowed
+        # registry history in a fixed-memory ring + burn-rate alerts
+        # over the serve objectives (TTFT p95, decode p50, error rate),
+        # targets from the engine's Config slo_* knobs (or a
+        # --slo-config JSON override). GET /metrics/history and
+        # GET /slo read these; `lumina top --url` draws them.
+        self.history: Optional[TimeSeriesRing] = None
+        self.slo: Optional[SLOEngine] = None
+        cfg = engine.config
+        if self.telemetry and slo and getattr(cfg, "slo", True):
+            self.history, self.slo = build_slo_stack(
+                cfg, registry=self.registry, recorder=self.recorder,
+                program="serve", slo_config=slo_config,
+            )
+            self._installed_history = get_history() is None
+            if self._installed_history:
+                set_history(self.history)
+            self.history.start()
+        else:
+            self._installed_history = False
         # Per-tenant token-bucket admission (rate_limiter.py): every
         # generation request costs one token from its tenant's bucket —
         # burst-tolerant, steady-state rate-bounded. Applies in _gate
@@ -1566,16 +1629,28 @@ class ChatServer:
         self.dump_flight_record("drain")
         # The server is done serving: stop the watchdog's monitor thread
         # (Trainer.close does the same) — a drained server must not keep
-        # a poller alive in embedding processes that cycle servers.
+        # a poller alive in embedding processes that cycle servers. The
+        # history sampler stops for the same reason.
         if getattr(self, "watchdog", None) is not None:
             self.watchdog.close()
+        if self.history is not None:
+            self.history.stop()
+            if self._installed_history and get_history() is self.history:
+                set_history(None)
         return idle
 
     def dump_flight_record(self, reason: str) -> Optional[str]:
         """Dump the wide-event ring buffer into flight_dir (no-op without
-        one). Never raises — it rides shutdown paths."""
+        one), plus the time-series history when SLO retention is on
+        (`lumina top <dir>` replays it). Never raises — it rides
+        shutdown paths."""
         if not self.flight_dir:
             return None
+        if self.history is not None:
+            self.history.dump_to_dir(
+                self.flight_dir, reason,
+                slo=self.slo.verdicts() if self.slo is not None else None,
+            )
         return self.recorder.dump_to_dir(self.flight_dir, reason)
 
     def _queue_depth(self) -> int:
@@ -1665,6 +1740,80 @@ class ChatServer:
             "batches": self.batcher.batches,
         }
 
+    def _staleness(self) -> Dict[str, Any]:
+        """Liveness ages for /healthz: seconds since the scheduler's
+        last decode tick and (when a trainer shares the process
+        registry) since the last train step. `stale` is True only when
+        a threshold is configured AND the process has work it is not
+        advancing — an idle scheduler is quiet, not stale."""
+        out: Dict[str, Any] = {}
+        now = time.time()
+        busy = False
+        if self.continuous:
+            last = getattr(self.batcher, "last_tick_ts", None)
+            if last is not None:
+                out["last_decode_tick_age_seconds"] = round(now - last, 3)
+            st = self._scheduler_state()
+            busy = bool(
+                st.get("active_lanes") or st.get("queue_depth")
+                or getattr(self.batcher, "_prefilling", None)
+            )
+        fam = self.registry.get("train_last_step_ts")
+        if fam is not None:
+            try:
+                ts = float(fam.value)
+            except (TypeError, ValueError):
+                ts = float("nan")
+            if ts == ts and ts > 0:  # NaN-safe: live train loop only
+                out["last_step_age_seconds"] = round(now - ts, 3)
+        thr = self.healthz_stale_after_s
+        if thr:
+            decode_stale = (
+                busy
+                and out.get("last_decode_tick_age_seconds") is not None
+                and out["last_decode_tick_age_seconds"] > thr
+            )
+            train_stale = (
+                out.get("last_step_age_seconds") is not None
+                and out["last_step_age_seconds"] > thr
+            )
+            out["stale"] = bool(decode_stale or train_stale)
+            out["stale_after_s"] = thr
+        return out
+
+    def history_route(
+        self, seconds: Optional[float] = None,
+        max_points: Optional[int] = None,
+    ) -> tuple:
+        """GET /metrics/history -> (status, payload): the ring's JSON
+        snapshot. ONE implementation behind both entries — handle()
+        (in-process, no query) and do_GET (parses ?seconds=&max_points=).
+        Budget-guarded twice over: the ring's own capacity/series budget
+        bounds the worst case, and the query params tighten a single
+        response."""
+        if self.history is None:
+            return 404, {
+                "error": "history ring disabled "
+                         "(--no-slo or telemetry off)"
+            }
+        # Query values come off the wire: float() accepts nan/inf, and
+        # int(nan) raises — a curl probe must get the full view, not a
+        # handler traceback. Non-finite/non-positive -> unclamped.
+        if seconds is not None and not (
+            math.isfinite(float(seconds)) and seconds > 0
+        ):
+            seconds = None
+        if max_points is not None:
+            mp = float(max_points)
+            max_points = (
+                max(1, min(int(mp), 10_000))
+                if math.isfinite(mp) and mp > 0
+                else None
+            )
+        return 200, self.history.snapshot(
+            window_s=seconds, max_points=max_points
+        )
+
     def render_metrics(self) -> str:
         return self.registry.render_prometheus()
 
@@ -1686,16 +1835,34 @@ class ChatServer:
             # in-flight work — a 5xx here would get it killed mid-drain.
             # Observers that care read `status` or the serve_draining
             # gauge (docker-compose.dev.yml's curl healthcheck tolerates
-            # the drain window by construction).
+            # the drain window by construction). Staleness: ages since
+            # the last decode tick / train step ride the body, and past
+            # --healthz-stale-after a BUSY-but-silent process reports
+            # "degraded" (still 200 — probes distinguish wedged from
+            # dead; the watchdog owns aborting).
+            status = "draining" if self._draining else "ok"
             out = {
-                "status": "draining" if self._draining else "ok",
                 "uptime_s": round(time.time() - self.t0, 1),
                 **self._scheduler_state(),
             }
+            stale = self._staleness()
+            out.update(stale)
+            if status == "ok" and stale.get("stale"):
+                status = "degraded"
+            out["status"] = status
             warm_err = getattr(self, "_warmup_error", None)
             if warm_err:
                 out["warmup_error"] = warm_err
             return 200, out
+        if method == "GET" and path == "/slo":
+            if self.slo is None:
+                return 404, {
+                    "error": "slo engine disabled "
+                             "(--no-slo or telemetry off)"
+                }
+            return 200, self.slo.verdicts()
+        if method == "GET" and path == "/metrics/history":
+            return self.history_route()
         if method == "GET" and path == "/health":
             cfg = self.engine.config
             return 200, {
@@ -2233,7 +2400,8 @@ class ChatServer:
                 logger.info("%s %s", self.address_string(), fmt % args)
 
             _KNOWN_ROUTES = (
-                "/", "/chat", "/health", "/healthz", "/metrics", "/stats",
+                "/", "/chat", "/health", "/healthz", "/metrics",
+                "/metrics/history", "/slo", "/stats",
                 "/v1/generate", "/v1/chat", "/v1/auth",
             )
 
@@ -2281,7 +2449,26 @@ class ChatServer:
             def do_GET(self):
                 # Health probes often add query strings (cache busting);
                 # route on the bare path.
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
+                if path == "/metrics/history":
+                    # Windowed-history query params (?seconds=&max_points=)
+                    # parse here — handle() stays query-string-free; the
+                    # route logic itself lives once, in history_route().
+                    from urllib.parse import parse_qs
+
+                    qs = parse_qs(query)
+
+                    def _num(key):
+                        try:
+                            return float(qs[key][0]) if key in qs else None
+                        except (TypeError, ValueError):
+                            return None
+
+                    self._reply(*server.history_route(
+                        seconds=_num("seconds"),
+                        max_points=_num("max_points"),
+                    ))
+                    return
                 if path == "/metrics":
                     # Prometheus text exposition: the one non-JSON API
                     # route. Rendered outside handle() so a scrape can
@@ -2454,6 +2641,9 @@ def serve(
     watchdog_abort: bool = False,
     watchdog_k: Optional[float] = None,
     watchdog_floor_s: Optional[float] = None,
+    slo: bool = True,
+    slo_config: Optional[str] = None,
+    healthz_stale_after_s: Optional[float] = None,
 ):
     """Build an engine from a checkpoint and serve it (CLI `serve`)."""
     from luminaai_tpu.inference.chat import ChatInterface
@@ -2493,6 +2683,12 @@ def serve(
         watchdog_abort=watchdog_abort,
         watchdog_k=watchdog_k,
         watchdog_floor_s=watchdog_floor_s,
+        # SLO engine + history ring (--no-slo disables; --slo-config
+        # replaces the default objectives; --healthz-stale-after flips
+        # /healthz to "degraded" on a busy-but-silent decode loop).
+        slo=slo,
+        slo_config=slo_config,
+        healthz_stale_after_s=healthz_stale_after_s,
         latency_buckets=(
             tuple(latency_buckets)
             if latency_buckets

@@ -35,7 +35,18 @@ from luminaai_tpu.models.transformer import LuminaTransformer
 from luminaai_tpu.monitoring.events import FlightRecorder, get_recorder
 from luminaai_tpu.monitoring.goodput import GoodputLedger
 from luminaai_tpu.monitoring.logger import TrainingHealthMonitor
-from luminaai_tpu.monitoring.telemetry import MetricsRegistry, get_registry
+from luminaai_tpu.monitoring.slo import SLOEngine, build_slo_stack
+from luminaai_tpu.monitoring.telemetry import (
+    MetricsRegistry,
+    get_registry,
+    register_build_info,
+    weak_callback,
+)
+from luminaai_tpu.monitoring.timeseries import (
+    TimeSeriesRing,
+    get_history,
+    set_history,
+)
 from luminaai_tpu.monitoring.tracing import NULL_TRACER, SpanTracer
 from luminaai_tpu.monitoring.watchdog import (
     HangWatchdog,
@@ -209,6 +220,56 @@ class Trainer:
             k=config.step_anomaly_k,
             enabled=config.step_anomaly,
         )
+        # Build identity (fleet debugging): one gauge whose labels say
+        # which commit/jax/config this process runs.
+        register_build_info(self.registry, config=config)
+        # SLO layer (docs/observability.md "SLOs & burn rate"): a
+        # fixed-memory ring retains windowed registry history on a
+        # background sampler thread, and the engine judges the default
+        # train objectives (goodput floor, step-time-vs-rolling-median)
+        # — or a --slo-config override — with multi-window burn-rate
+        # rules. Host-side only; the sampler reads what producers
+        # already wrote.
+        self.history: Optional[TimeSeriesRing] = None
+        self.slo: Optional[SLOEngine] = None
+        if config.slo:
+            self.history, self.slo = build_slo_stack(
+                config, registry=self.registry, recorder=self.recorder,
+                program="train",
+            )
+            # First ring installed wins the process default (`lumina
+            # top` with no source reads it); close() restores.
+            self._prev_history = (
+                set_history(self.history) if get_history() is None else None
+            )
+            self._installed_history = get_history() is self.history
+        else:
+            self._installed_history = False
+        # Liveness for /healthz staleness (a colocated server reads the
+        # gauge): wall ts of the last completed optimizer step, NaN when
+        # no train loop is live OR while the loop is legitimately inside
+        # slow host work (eval / checkpoint — the same windows the
+        # watchdog pauses for), so a long eval can't read as wedged.
+        # Resume replay needs no entry here: it accrues inside data_wait
+        # with the stamp reset at train() entry, so there is no stale
+        # stamp to age. Plain host attribute writes — no new syncs.
+        self._last_step_wall: Optional[float] = None
+        self._training_active = False
+        _SLOW_HOST_CAUSES = ("eval", "checkpoint")
+
+        def _liveness_ts(t: "Trainer") -> float:
+            if not t._training_active or not t._last_step_wall:
+                return float("nan")
+            if t.goodput.current_cause() in _SLOW_HOST_CAUSES:
+                return float("nan")
+            return t._last_step_wall
+
+        self.registry.gauge(
+            "train_last_step_ts",
+            "Wall-clock timestamp of the last completed train step "
+            "(NaN outside a live train loop or during eval/checkpoint "
+            "windows)",
+        ).set_function(weak_callback(self, _liveness_ts))
         self.checkpoints = CheckpointManager(
             config, ckpt_dir, registry=self.registry,
             recorder=self.recorder,
@@ -963,11 +1024,21 @@ class Trainer:
 
         Returns a summary dict (ref trainer.py:3180 train)."""
         try:
+            # Fresh entry (incl. OOM-ladder re-entry in one process): a
+            # prior run's step stamp must not age into a false
+            # "degraded" while this run resumes/replays/compiles.
+            self._last_step_wall = None
+            self._training_active = True
+            if self.history is not None:
+                self.history.start()  # idempotent across train() calls
             return self._train_inner()
         finally:
             # Whatever path exits (done, preempted, OOM ladder re-entry,
             # propagated failure): the watchdog must stop watching a
-            # loop that no longer beats, and post-run time is idle.
+            # loop that no longer beats, and post-run time is idle. The
+            # liveness gauge flips to NaN so /healthz staleness can't
+            # flag a finished trainer as wedged.
+            self._training_active = False
             if self.watchdog is not None:
                 self.watchdog.disarm()
             self.goodput.switch("idle")
@@ -1039,6 +1110,9 @@ class Trainer:
                     self.state, metrics = self.train_step(self.state, batch)
                 self.global_step += 1
                 self._batch_in_epoch += 1
+                # Liveness stamp for /healthz staleness (host clock read,
+                # not a device sync — the dispatch above is async).
+                self._last_step_wall = time.time()
                 n_tok = int(batch["input_ids"].size)
                 tokens_seen += n_tok
                 window_tokens += n_tok
@@ -1230,6 +1304,18 @@ class Trainer:
             # idle, partitioned by construction.
             "goodput": self.goodput.snapshot(),
         }
+        if self.slo is not None:
+            # Final verdict over everything the ring retained: one last
+            # sample so short runs (whose sampler may never have ticked)
+            # still carry objective states. The attached engine already
+            # evaluated via the sample listener — verdicts() reads that
+            # result; a second evaluate() here would advance the clear
+            # hysteresis an extra step.
+            self.history.sample_once()
+            summary["slo"] = {
+                **self.slo.verdicts(),
+                "ring": self.history.stats(),
+            }
         logger.info("training done: %s", summary)
         return summary
 
@@ -1356,8 +1442,14 @@ class Trainer:
     def _dump_flight_record(self, reason: str) -> Optional[str]:
         """Dump the wide-event ring next to the checkpoints so the last
         N step/request events survive the exit (`lumina events` replays
-        the flightrec-*.jsonl). Never raises — it rides the emergency
-        paths."""
+        the flightrec-*.jsonl), plus the time-series history when SLO
+        retention is on (`lumina top <ckpt-dir>` replays the tshist-*
+        snapshot). Never raises — it rides the emergency paths."""
+        if self.history is not None:
+            self.history.dump_to_dir(
+                str(self.checkpoints.dir), reason,
+                slo=self.slo.verdicts() if self.slo is not None else None,
+            )
         return self.recorder.dump_to_dir(str(self.checkpoints.dir), reason)
 
     # -- profiling (SURVEY §5 tracing) -------------------------------------
@@ -1668,6 +1760,10 @@ class Trainer:
             self._profiling = False
         if self.watchdog is not None:
             self.watchdog.close()
+        if self.history is not None:
+            self.history.stop()
+            if self._installed_history and get_history() is self.history:
+                set_history(getattr(self, "_prev_history", None))
         self.checkpoints.close()
         self.goodput.stop()
         set_default_policy(self._prev_io_policy)
