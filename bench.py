@@ -1125,6 +1125,232 @@ def _serve_bench_main(smoke: bool) -> None:
         sys.exit(1)
 
 
+def _router_bench_main(smoke: bool) -> None:
+    """Serving-plane router bench: a 2-replica local fleet behind the
+    data-plane router (serving/router.py), then the kill-one-replica
+    rung. Headline: aggregate tokens/sec through the router;
+    vs_baseline: the same workload driven at ONE replica directly (so
+    >1 means the 2-replica fan-out pays for the router hop).
+    extras.router carries the robustness rung CI asserts: failovers>0,
+    post-kill success rate 1.0, breaker_opened true.
+
+    Hermetic by contract: synthetic engine (no jax, no checkpoint — the
+    router is pure host Python and the rung measures routing, not
+    decode), loopback sockets only, exactly ONE JSON line; any failure
+    rides an "error" field and exits 1.
+    """
+    result = {
+        "metric": "router_tokens_per_sec_2replica",
+        "value": 0.0,
+        "unit": "tokens/sec",
+        "vs_baseline": 0.0,
+    }
+    try:
+        import threading
+        import types
+        import urllib.error
+        import urllib.request
+        from http.server import ThreadingHTTPServer
+
+        from luminaai_tpu.config import Config
+        from luminaai_tpu.monitoring.events import FlightRecorder
+        from luminaai_tpu.monitoring.telemetry import MetricsRegistry
+        from luminaai_tpu.serving.router import Router
+        from luminaai_tpu.serving.server import ChatServer
+        from luminaai_tpu.testing.faults import kill_replica
+
+        class _Backend:
+            def encode(self, text):
+                return [ord(c) % 250 for c in text]
+
+        class _Tok:
+            backend = _Backend()
+
+            def decode(self, tokens):
+                return "tok:" + ",".join(str(t) for t in tokens)
+
+        class _Eng:
+            """Minimal engine contract (mirrors GenerationEngine's
+            surface the way tests/test_serving.py's double does) with a
+            fixed per-token pace, so tokens/sec measures the routing
+            plane, not model arithmetic."""
+
+            TICK_S = 0.0005
+
+            def __init__(self):
+                self.config = Config(
+                    vocab_size=256, hidden_size=64, num_layers=2,
+                    num_heads=4, num_kv_heads=2, seq_length=64,
+                    use_flash_attention=False,
+                )
+                self.tokenizer = _Tok()
+
+            def generate(self, prompt_tokens, max_new_tokens=16, **kw):
+                n = max(1, min(int(max_new_tokens), 64))
+                time.sleep(self.TICK_S * n)
+                toks = [t % 250 for t in list(prompt_tokens)[:n]] or [1]
+                return toks, {"tokens_generated": len(toks),
+                              "stopped": "eos"}
+
+            def generate_batch(self, prompts, **kw):
+                return [self.generate(p, **kw) for p in prompts]
+
+            def encode_chat(self, messages):
+                return self.tokenizer.backend.encode(
+                    messages[-1]["content"]
+                )
+
+        def _spawn_replica():
+            srv = ChatServer(_Eng(), registry=MetricsRegistry())
+            httpd = ThreadingHTTPServer(
+                ("127.0.0.1", 0), srv.make_handler()
+            )
+            threading.Thread(
+                target=httpd.serve_forever, daemon=True
+            ).start()
+            url = f"http://127.0.0.1:{httpd.server_address[1]}"
+            return types.SimpleNamespace(server=srv, httpd=httpd, url=url)
+
+        replicas = [_spawn_replica(), _spawn_replica()]
+        recorder = FlightRecorder(capacity=4096)
+        registry = MetricsRegistry()
+        router = Router(
+            [("r0", replicas[0].url), ("r1", replicas[1].url)],
+            registry=registry, recorder=recorder,
+            probe_interval_s=0.2, breaker_failures=3,
+            breaker_cooldown_s=1.0, max_failovers=1,
+        )
+        router.probe_all()
+        httpd_r = ThreadingHTTPServer(
+            ("127.0.0.1", 0), router.make_handler()
+        )
+        threading.Thread(
+            target=httpd_r.serve_forever, daemon=True
+        ).start()
+        router_url = f"http://127.0.0.1:{httpd_r.server_address[1]}"
+
+        prompts = [
+            "system alpha: summarize the day",
+            "system beta: write a haiku now",
+            "system gamma: translate to french",
+            "system delta: count to twenty",
+        ]
+
+        def drive(base, n, out, offset=0):
+            for i in range(n):
+                body = {
+                    "prompt": prompts[(offset + i) % len(prompts)],
+                    "max_new_tokens": 16,
+                }
+                req = urllib.request.Request(
+                    base + "/v1/generate",
+                    data=json.dumps(body).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                try:
+                    with urllib.request.urlopen(req, timeout=30) as r:
+                        out.append((r.status, json.loads(r.read())))
+                except urllib.error.HTTPError as e:
+                    out.append((e.code, {}))
+                except Exception as e:  # transport-level failure
+                    out.append((0, {"error": str(e)}))
+
+        per_client = 6 if smoke else 24
+        n_clients = 4
+
+        # -- baseline: one replica, driven directly --------------------
+        base_out: list = []
+        ts = [threading.Thread(target=drive,
+                               args=(replicas[0].url, per_client,
+                                     base_out, c))
+              for c in range(n_clients)]
+        t0 = time.perf_counter()
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        base_dt = time.perf_counter() - t0
+        base_tokens = sum(p.get("tokens", 0) for _, p in base_out)
+        base_tps = base_tokens / max(base_dt, 1e-9)
+
+        # -- measured: the same workload through the router ------------
+        routed_out: list = []
+        ts = [threading.Thread(target=drive,
+                               args=(router_url, per_client,
+                                     routed_out, c))
+              for c in range(n_clients)]
+        t0 = time.perf_counter()
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        routed_dt = time.perf_counter() - t0
+        routed_tokens = sum(p.get("tokens", 0) for _, p in routed_out)
+        routed_tps = routed_tokens / max(routed_dt, 1e-9)
+        n_routed = len(routed_out)
+        routed_ok = sum(1 for c, _ in routed_out if c == 200)
+        share = {
+            r.name: round(r.requests / max(1, sum(
+                x.requests for x in router.replicas
+            )), 3)
+            for r in router.replicas
+        }
+
+        # -- kill-one-replica rung -------------------------------------
+        kill_replica(replicas[1])
+        post_out: list = []
+        drive(router_url, 4 if smoke else 8, post_out)  # organic failover
+        router.probe_all()  # dead endpoint -> breaker trips
+        drive(router_url, 4 if smoke else 8, post_out, offset=2)
+        failovers = len(recorder.snapshot(type="router_failover"))
+        breaker_opened = bool(recorder.snapshot(type="breaker_open"))
+        post_ok = sum(1 for c, _ in post_out if c == 200)
+        post_rate = post_ok / max(1, len(post_out))
+
+        httpd_r.shutdown()
+        httpd_r.server_close()
+        for rep in replicas[:1]:
+            rep.httpd.shutdown()
+            rep.httpd.server_close()
+
+        result.update(
+            value=round(routed_tps, 1),
+            vs_baseline=round(routed_tps / max(base_tps, 1e-9), 3),
+            extras={
+                "mode": "smoke" if smoke else "full",
+                "requests": n_routed,
+                "direct_tokens_per_sec": round(base_tps, 1),
+                "router": {
+                    "replicas": 2,
+                    "routed_ok": routed_ok,
+                    "routed_requests": n_routed,
+                    "per_replica_share": share,
+                    "failovers": failovers,
+                    "post_kill_requests": len(post_out),
+                    "post_kill_success_rate": round(post_rate, 3),
+                    "breaker_opened": breaker_opened,
+                    "breaker_states": {
+                        r.name: r.breaker.state
+                        for r in router.replicas
+                    },
+                },
+            },
+        )
+        if routed_ok != n_routed:
+            result["error"] = (
+                f"routed phase lost requests: {routed_ok}/{n_routed}"
+            )
+        elif failovers < 1:
+            result["error"] = "kill rung produced zero failovers"
+        elif post_rate != 1.0:
+            result["error"] = (
+                f"post-kill success rate {post_rate} != 1.0"
+            )
+        elif not breaker_opened:
+            result["error"] = "breaker never opened after replica kill"
+    except Exception as e:  # the artifact must stay parseable
+        result["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(result), flush=True)
+    if "error" in result:
+        sys.exit(1)
+
+
 _HERE = os.path.dirname(os.path.abspath(__file__))
 LAST_GOOD_PATH = os.path.join(_HERE, "scripts", "last_good_bench.json")
 
@@ -2167,6 +2393,10 @@ if __name__ == "__main__":
         _serve_bench_main(smoke=True)
     elif "--serve-bench" in sys.argv[1:]:
         _serve_bench_main(smoke=False)
+    elif "--smoke-router" in sys.argv[1:]:
+        _router_bench_main(smoke=True)
+    elif "--router-bench" in sys.argv[1:]:
+        _router_bench_main(smoke=False)
     elif "--smoke" in sys.argv[1:]:
         # Hermetic CPU smoke of the TRAIN bench child, with the full
         # attribution surface: compiled cost-analysis extras for the

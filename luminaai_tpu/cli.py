@@ -627,9 +627,79 @@ def cmd_evaluate(args) -> int:
     return 0
 
 
+def _fleet_child_argv(argv: List[str], port: int) -> List[str]:
+    """Rebuild a replica's serve argv from the parent's: same flags,
+    its own port, no --replicas (a replica must not recurse)."""
+    out: List[str] = []
+    skip = False
+    for a in argv:
+        if skip:
+            skip = False
+            continue
+        if a in ("--replicas", "--port"):
+            skip = True
+            continue
+        if a.startswith("--replicas=") or a.startswith("--port="):
+            continue
+        out.append(a)
+    return out + ["--port", str(port)]
+
+
+def _serve_fleet(args) -> int:
+    """`lumina serve --replicas N`: spawn N replica serve processes on
+    port+1..port+N, wait for their /healthz, then front them with the
+    router on --port. Dev-fleet ergonomics — one command, one ^C."""
+    import signal
+    import subprocess
+
+    from luminaai_tpu.config import Config
+    from luminaai_tpu.serving.router import Router, wait_ready
+
+    cfg = Config()
+    n = args.replicas
+    ports = [args.port + 1 + i for i in range(n)]
+    urls = [f"http://{args.host}:{p}" for p in ports]
+    procs = []
+    try:
+        for p in ports:
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "luminaai_tpu"]
+                + _fleet_child_argv(sys.argv[1:], p)
+            ))
+        print(f"fleet: {n} replica(s) on ports {ports}; waiting for "
+              "warmup...", file=sys.stderr)
+        wait_ready(urls, timeout_s=600.0)
+        router = Router(
+            list(zip([f"r{i}" for i in range(n)], urls)),
+            probe_interval_s=cfg.router_probe_interval_s,
+            breaker_failures=cfg.router_breaker_failures,
+            breaker_cooldown_s=cfg.router_breaker_cooldown_s,
+            max_failovers=min(cfg.router_max_failovers, n - 1),
+            hedge_budget=cfg.router_hedge_budget,
+            hedge_max_tokens=cfg.router_hedge_max_tokens,
+            flight_dir=getattr(args, "flight_dir", None),
+        )
+        router.probe_all()
+        router.start_probing()
+        router.serve_forever(args.host, args.port)
+        return 0
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for proc in procs:
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
 def cmd_serve(args) -> int:
     """HTTP chat/completion server (ref Dockerfile.backend: Flask on :5001
-    with /health; here stdlib http.server — luminaai_tpu/serving)."""
+    with /health; here stdlib http.server — luminaai_tpu/serving).
+    --replicas N spawns a local fleet fronted by the replica router."""
+    if getattr(args, "replicas", 1) > 1:
+        return _serve_fleet(args)
     from luminaai_tpu.serving import serve
 
     bootstrap = None
@@ -714,6 +784,58 @@ def cmd_serve(args) -> int:
         slo=not getattr(args, "no_slo", False),
         slo_config=getattr(args, "slo_config", None),
         healthz_stale_after_s=getattr(args, "healthz_stale_after", None),
+    )
+    return 0
+
+
+def cmd_route(args) -> int:
+    """Health-aware data-plane router fronting N ChatServer replicas
+    (docs/serving.md "Replica router"): active /healthz + /slo probing,
+    per-replica circuit breakers, prefix-hash-affine dispatch with
+    bounded failover, Retry-After-aware shedding, optional hedged
+    dispatch. Flag defaults come from Config's router_* knobs."""
+    from luminaai_tpu.config import Config
+    from luminaai_tpu.serving.router import run_router
+
+    cfg = Config()
+
+    def knob(name, default):
+        v = getattr(args, name, None)
+        return default if v is None else v
+
+    urls = []
+    for u in args.replicas:
+        if "://" not in u:
+            u = "http://" + u
+        urls.append(u.rstrip("/"))
+    if len(urls) != len(set(urls)):
+        print("duplicate --replica urls", file=sys.stderr)
+        return 2
+    run_router(
+        urls,
+        host=args.host,
+        port=args.port,
+        probe_interval_s=knob(
+            "probe_interval_s", cfg.router_probe_interval_s
+        ),
+        breaker_failures=knob(
+            "breaker_failures", cfg.router_breaker_failures
+        ),
+        breaker_cooldown_s=knob(
+            "breaker_cooldown_s", cfg.router_breaker_cooldown_s
+        ),
+        max_failovers=min(
+            knob("max_failovers", cfg.router_max_failovers),
+            len(urls) - 1,
+        ),
+        request_timeout_s=getattr(args, "request_timeout_s", None),
+        hedge=getattr(args, "hedge", False),
+        hedge_delay_s=getattr(args, "hedge_delay_s", None),
+        hedge_budget=knob("hedge_budget", cfg.router_hedge_budget),
+        hedge_max_tokens=knob(
+            "hedge_max_tokens", cfg.router_hedge_max_tokens
+        ),
+        flight_dir=getattr(args, "flight_dir", None),
     )
     return 0
 
@@ -1300,8 +1422,8 @@ def _print_grouped_stats(stats: Dict[str, Any]) -> None:
 def _top_sources(args):
     """Resolve `lumina top`'s data source into (fetch_fn, source_label).
 
-    fetch_fn() -> (history_dict, slo_dict_or_None). Exit-2 errors raise
-    SystemExit here so the caller stays flat."""
+    fetch_fn() -> (history_dict, slo_dict_or_None, fleet_dict_or_None).
+    Exit-2 errors raise SystemExit here so the caller stays flat."""
     import urllib.error
     import urllib.request
 
@@ -1317,17 +1439,28 @@ def _top_sources(args):
         base = url.rstrip("/")
 
         def fetch_url():
-            with urllib.request.urlopen(
-                f"{base}/metrics/history", timeout=10
-            ) as r:
-                history = json.loads(r.read())
-            slo = None
-            try:
-                with urllib.request.urlopen(f"{base}/slo", timeout=10) as r:
-                    slo = json.loads(r.read())
-            except urllib.error.HTTPError:
-                pass  # SLO engine disabled server-side: history-only view
-            return history, slo
+            # --url points at either a replica (history + slo) or a
+            # router (fleet table). Probe both shapes; a missing route
+            # 404s, which just means the other kind of process.
+            def _get(route):
+                try:
+                    with urllib.request.urlopen(
+                        f"{base}{route}", timeout=10
+                    ) as r:
+                        return json.loads(r.read())
+                except urllib.error.HTTPError:
+                    return None
+
+            history = _get("/metrics/history")
+            slo = _get("/slo")
+            fleet = _get("/fleet")
+            if history is None and fleet is None:
+                print(
+                    f"{base} answers neither /metrics/history (replica) "
+                    "nor /fleet (router)", file=sys.stderr,
+                )
+                raise SystemExit(2)
+            return history or {"series": {}}, slo, fleet
 
         return fetch_url, base
     if path:
@@ -1350,7 +1483,7 @@ def _top_sources(args):
                 raise SystemExit(2)
             # Dumps written by a live SLO engine embed the verdict table
             # so the post-mortem view matches the live one.
-            return doc, doc.get("slo")
+            return doc, doc.get("slo"), None
 
         return fetch_file, resolved
 
@@ -1373,7 +1506,7 @@ def _top_sources(args):
         engine = getattr(ring, "slo", None)
         return ring.snapshot(), (
             engine.verdicts() if engine is not None else None
-        )
+        ), None
 
     return fetch_live, "<live ring>"
 
@@ -1395,7 +1528,7 @@ def cmd_top(args) -> int:
 
     def frame():
         try:
-            history, slo = fetch()
+            history, slo, fleet = fetch()
         except SystemExit as e:  # bad dump discovered on read
             raise
         except Exception as e:
@@ -1406,12 +1539,14 @@ def cmd_top(args) -> int:
                 top_payload(
                     history, slo,
                     window_s=args.window, top_k=args.top_k,
+                    fleet=fleet,
                 ),
                 default=str,
             )
         return render_top(
             history, slo, source=source,
             window_s=args.window, top_k=args.top_k,
+            fleet=fleet,
         )
 
     try:
@@ -1863,7 +1998,63 @@ def build_parser() -> argparse.ArgumentParser:
                          "reports status=degraded (still 200) so "
                          "probes catch wedged-but-alive processes "
                          "before the watchdog aborts")
+    sv.add_argument("--replicas", type=int, default=1,
+                    help="spawn N replica serve processes (ports "
+                         "port+1..port+N) fronted by the replica "
+                         "router on --port — the one-command dev "
+                         "fleet (docs/serving.md 'Replica router')")
     sv.set_defaults(fn=cmd_serve)
+
+    rt = sub.add_parser(
+        "route",
+        help="data-plane router fronting N serve replicas: health "
+             "probing, circuit breakers, affine dispatch + failover, "
+             "hedged retries",
+    )
+    rt.add_argument("--replica", dest="replicas", action="append",
+                    required=True,
+                    help="replica base URL (repeat per replica)")
+    rt.add_argument("--host", default="127.0.0.1")
+    rt.add_argument("--port", type=int, default=8000)
+    rt.add_argument("--probe-interval", dest="probe_interval_s",
+                    type=float, default=None,
+                    help="seconds between /healthz+/slo probe rounds "
+                         "(default: config router_probe_interval_s)")
+    rt.add_argument("--breaker-failures", dest="breaker_failures",
+                    type=int, default=None,
+                    help="consecutive failures opening a replica's "
+                         "circuit breaker (default: config)")
+    rt.add_argument("--breaker-cooldown", dest="breaker_cooldown_s",
+                    type=float, default=None,
+                    help="seconds an open breaker waits before its "
+                         "half-open probe (default: config)")
+    rt.add_argument("--max-failovers", dest="max_failovers", type=int,
+                    default=None,
+                    help="extra candidates a failed dispatch may try "
+                         "(capped at replicas-1; default: config)")
+    rt.add_argument("--request-timeout", dest="request_timeout_s",
+                    type=float, default=None,
+                    help="per-attempt replica timeout in seconds")
+    rt.add_argument("--hedge", action="store_true",
+                    help="hedged dispatch: fire a second replica for "
+                         "short non-stream requests after a p95-based "
+                         "delay; first answer wins, loser cancelled")
+    rt.add_argument("--hedge-delay", dest="hedge_delay_s", type=float,
+                    default=None,
+                    help="fixed hedge delay in seconds (default: the "
+                         "fleet's observed p95)")
+    rt.add_argument("--hedge-budget", dest="hedge_budget", type=float,
+                    default=None,
+                    help="max hedged fraction of non-stream traffic "
+                         "(default: config router_hedge_budget)")
+    rt.add_argument("--hedge-max-tokens", dest="hedge_max_tokens",
+                    type=int, default=None,
+                    help="only hedge requests asking for at most this "
+                         "many new tokens (default: config)")
+    rt.add_argument("--flight-dir", dest="flight_dir",
+                    help="dump the router's wide-event flight record "
+                         "here on exit (flightrec-*.jsonl)")
+    rt.set_defaults(fn=cmd_route)
 
     b = sub.add_parser("benchmark", help="run the bench harness")
     b.add_argument("--ops", action="store_true",

@@ -414,6 +414,23 @@ class Config:
     # loss can't masquerade as health.
     data_quarantine: bool = True
     data_quarantine_max_rate: float = 0.05
+    # --- Serving-plane router (docs/serving.md "Replica router") ---
+    # The data-plane router fronting N ChatServer replicas
+    # (serving/router.py): health probes every router_probe_interval_s;
+    # a replica's circuit breaker opens after router_breaker_failures
+    # consecutive failures (or the error-rate threshold) and re-probes
+    # half-open after router_breaker_cooldown_s; a failed dispatch
+    # retries on up to router_max_failovers other candidates with
+    # backoff+jitter. Hedged dispatch (opt-in, `lumina route --hedge`)
+    # fires a second replica for short (< router_hedge_max_tokens)
+    # non-stream requests after a p95-based delay, capped at
+    # router_hedge_budget of non-stream traffic.
+    router_probe_interval_s: float = 2.0
+    router_breaker_failures: int = 3
+    router_breaker_cooldown_s: float = 5.0
+    router_max_failovers: int = 2
+    router_hedge_budget: float = 0.1
+    router_hedge_max_tokens: int = 32
 
     # --- Adaptive control (orchestrator) ---
     enable_adaptive_lr: bool = True
@@ -602,6 +619,24 @@ class Config:
         )
         assert 0.0 < self.data_quarantine_max_rate <= 1.0, (
             "data_quarantine_max_rate must be in (0, 1]"
+        )
+        assert self.router_probe_interval_s > 0, (
+            "router_probe_interval_s must be positive"
+        )
+        assert self.router_breaker_failures >= 1, (
+            "router_breaker_failures must be >= 1"
+        )
+        assert self.router_breaker_cooldown_s > 0, (
+            "router_breaker_cooldown_s must be positive"
+        )
+        assert self.router_max_failovers >= 0, (
+            "router_max_failovers must be >= 0"
+        )
+        assert 0.0 <= self.router_hedge_budget <= 1.0, (
+            "router_hedge_budget must be in [0, 1]"
+        )
+        assert self.router_hedge_max_tokens >= 1, (
+            "router_hedge_max_tokens must be >= 1"
         )
         if self.use_moe:
             assert self.moe_top_k <= self.num_experts, "moe_top_k must be <= num_experts"

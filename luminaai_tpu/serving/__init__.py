@@ -1,3 +1,9 @@
+from luminaai_tpu.serving.router import (
+    CircuitBreaker,
+    HttpTransport,
+    Replica,
+    Router,
+)
 from luminaai_tpu.serving.server import (
     ChatServer,
     ContinuousScheduler,
@@ -5,4 +11,13 @@ from luminaai_tpu.serving.server import (
     serve,
 )
 
-__all__ = ["ChatServer", "ContinuousScheduler", "MicroBatcher", "serve"]
+__all__ = [
+    "ChatServer",
+    "CircuitBreaker",
+    "ContinuousScheduler",
+    "HttpTransport",
+    "MicroBatcher",
+    "Replica",
+    "Router",
+    "serve",
+]

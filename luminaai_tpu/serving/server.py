@@ -53,6 +53,7 @@ import json
 import logging
 import math
 import queue
+import re
 import threading
 import time
 import uuid
@@ -80,6 +81,10 @@ from luminaai_tpu.security.auth import ANON_TENANT, tenant_hash
 logger = logging.getLogger(__name__)
 
 MAX_BODY_BYTES = 1 << 20  # 1MB request cap (input_validator also re-checks)
+
+# Shape an inbound X-Request-Id must match to be honored (router-minted
+# ids are 12 hex chars; anything else sane is fine, garbage is not).
+REQUEST_ID_RX = re.compile(r"[A-Za-z0-9_-]{1,64}")
 
 
 def new_request_id() -> str:
@@ -1819,8 +1824,15 @@ class ChatServer:
 
     # -- request handling --------------------------------------------------
     def handle(self, method: str, path: str, body: Dict[str, Any],
-               token: Optional[str]) -> tuple:
-        """Returns (status_code, payload dict). Pure-ish: no socket I/O."""
+               token: Optional[str],
+               request_id: Optional[str] = None) -> tuple:
+        """Returns (status_code, payload dict). Pure-ish: no socket I/O.
+
+        `request_id` is an inbound `X-Request-Id` (already validated by
+        the HTTP handler): a fronting router minted it, and honoring it
+        here means one id correlates the request across the router's and
+        this replica's flight rings (`lumina events --request <id>`).
+        Absent, we mint as before."""
         if method == "GET" and path == "/healthz":
             # Readiness (vs /health's liveness): 503 while the engine is
             # compiling/warming so orchestrators hold traffic, 200 with
@@ -1901,7 +1913,7 @@ class ChatServer:
                 return 401, {"error": "authentication failed"}
             return 200, {"token": token}
         if method == "POST" and path in ("/v1/generate", "/v1/chat"):
-            request_id = new_request_id()
+            request_id = request_id or new_request_id()
             shed = self._shed()  # drain/overload: reject before auth work
             if shed is not None:
                 self._count_shed(request_id, token, path)
@@ -2186,13 +2198,16 @@ class ChatServer:
 
     # -- streaming (SSE) ---------------------------------------------------
     def start_stream(self, path: str, body: Dict[str, Any],
-                     token: Optional[str]):
+                     token: Optional[str],
+                     request_id: Optional[str] = None):
         """Begin a streamed generation. Returns (error_tuple | None,
         events_generator | None). Streaming runs the engine's chunked
         decode directly (one stream per request thread) rather than the
         MicroBatcher — each stream owns its decode cadence; batched SSE
-        would couple every client's latency to the slowest stream."""
-        request_id = new_request_id()
+        would couple every client's latency to the slowest stream.
+        An inbound `X-Request-Id` (router-minted) is honored like
+        handle()'s, so stream events correlate across tiers."""
+        request_id = request_id or new_request_id()
         shed = self._shed()  # drain/overload applies to streams too
         if shed is not None:
             self._count_shed(request_id, token, path)
@@ -2446,6 +2461,14 @@ class ChatServer:
                 auth = self.headers.get("Authorization", "")
                 return auth[7:] if auth.startswith("Bearer ") else None
 
+            def _request_id(self) -> Optional[str]:
+                # Inbound X-Request-Id (router-minted). Validated so a
+                # hostile client can't inject log/JSONL garbage into two
+                # tiers of flight rings; anything dubious is ignored and
+                # the server mints its own as before.
+                rid = self.headers.get("X-Request-Id", "")
+                return rid if REQUEST_ID_RX.fullmatch(rid) else None
+
             def do_GET(self):
                 # Health probes often add query strings (cache busting);
                 # route on the bare path.
@@ -2561,7 +2584,8 @@ class ChatServer:
                             and path in ("/v1/generate", "/v1/chat")
                         ):
                             err, events = server.start_stream(
-                                path, body, self._token()
+                                path, body, self._token(),
+                                request_id=self._request_id(),
                             )
                             if err is not None:
                                 self._reply(*err)
@@ -2569,7 +2593,8 @@ class ChatServer:
                                 self._reply_sse(events)
                             return
                         code, payload = server.handle(
-                            "POST", path, body, self._token()
+                            "POST", path, body, self._token(),
+                            request_id=self._request_id(),
                         )
                 except Exception as e:  # surface as 500, keep serving
                     logger.exception("request failed")

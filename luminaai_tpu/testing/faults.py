@@ -19,6 +19,7 @@ import contextlib
 import logging
 import os
 import signal as _signal
+import threading
 import time
 from pathlib import Path
 from typing import Callable, Iterator, Optional
@@ -313,6 +314,109 @@ def torn_manifest(checkpoint_dir, step: int) -> str:
     m.write_bytes(data[: max(1, len(data) // 2)])
     logger.warning("tore manifest %s to %d bytes", m, max(1, len(data) // 2))
     return str(m)
+
+
+def kill_replica(replica) -> None:
+    """Kill one serving replica the unclean way. A subprocess replica
+    (anything with a .pid) gets a real SIGKILL — mid-stream sockets are
+    severed with no FIN-and-drain courtesy. An in-process replica (a
+    ThreadingHTTPServer, or anything with an .httpd) has its listening
+    socket closed immediately, so every NEW connection is refused like a
+    dead host's would be; in-flight handler threads keep their already-
+    accepted sockets (in-process tests drive mid-stream death through
+    the router's transport seam instead, and the multi-process smoke
+    exercises the real-SIGKILL shape end to end)."""
+    pid = getattr(replica, "pid", None)
+    if pid is not None:
+        os.kill(int(pid), _signal.SIGKILL)
+        return
+    httpd = getattr(replica, "httpd", replica)
+    try:
+        httpd.socket.close()  # refuse new connections NOW
+    except OSError:
+        pass
+    # Unblock the accept loop without waiting on in-flight handlers
+    # (shutdown() joins the poll loop; a fault injector must not).
+    threading.Thread(target=httpd.shutdown, daemon=True).start()
+    logger.warning("killed in-process replica on %s",
+                   getattr(httpd, "server_address", "?"))
+
+
+@contextlib.contextmanager
+def replica_5xx_burst(server, times: int = 5,
+                      status: int = 500) -> Iterator[dict]:
+    """Make one ChatServer's next `times` generation requests (JSON and
+    SSE alike) answer `status` before any model work — the flapping-
+    dependency shape a fronting router's circuit breaker must absorb:
+    the burst opens the breaker, the half-open probe after the cooldown
+    finds the burst exhausted and closes it. Yields
+    {'calls', 'failed'}."""
+    stats = {"calls": 0, "failed": 0}
+    orig_handle = server.handle
+    orig_stream = server.start_stream
+
+    def handle(method, path, body, token, request_id=None):
+        if method == "POST" and path in ("/v1/generate", "/v1/chat"):
+            stats["calls"] += 1
+            if stats["failed"] < times:
+                stats["failed"] += 1
+                return status, {"error": "injected replica fault"}
+        return orig_handle(method, path, body, token,
+                           request_id=request_id)
+
+    def start_stream(path, body, token, request_id=None):
+        stats["calls"] += 1
+        if stats["failed"] < times:
+            stats["failed"] += 1
+            return (status, {"error": "injected replica fault"}), None
+        return orig_stream(path, body, token, request_id=request_id)
+
+    server.handle = handle
+    server.start_stream = start_stream
+    try:
+        yield stats
+    finally:
+        _restore(server, "handle", handle, orig_handle)
+        _restore(server, "start_stream", start_stream, orig_stream)
+
+
+@contextlib.contextmanager
+def slow_replica(server_or_engine, delay_s: float = 0.2) -> Iterator[dict]:
+    """Inflate every decode tick on ONE replica's engine — the slow-
+    replica fleet shape hedged dispatch exists for: the affine target
+    still answers, just late, so only a hedge (not a failover) recovers
+    the tail. Wraps the engine's generate / generate_batch /
+    generate_stream; pass a ChatServer or the engine itself. Yields
+    {'calls'}."""
+    engine = getattr(server_or_engine, "engine", server_or_engine)
+    stats = {"calls": 0}
+    wrapped = []
+
+    def _wrap(name):
+        original = getattr(engine, name, None)
+        if original is None:
+            return
+        if name == "generate_stream":
+            def wrapper(*args, **kwargs):
+                stats["calls"] += 1
+                for ev in original(*args, **kwargs):
+                    time.sleep(delay_s)
+                    yield ev
+        else:
+            def wrapper(*args, **kwargs):
+                stats["calls"] += 1
+                time.sleep(delay_s)
+                return original(*args, **kwargs)
+        setattr(engine, name, wrapper)
+        wrapped.append((name, wrapper, original))
+
+    for name in ("generate", "generate_batch", "generate_stream"):
+        _wrap(name)
+    try:
+        yield stats
+    finally:
+        for name, wrapper, original in wrapped:
+            _restore(engine, name, wrapper, original)
 
 
 @contextlib.contextmanager

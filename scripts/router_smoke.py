@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""Multi-process router smoke: the real-SIGKILL shape of ISSUE 19.
+
+Spawns two replica subprocesses (this script re-invoked with
+--replica), fronts them with an in-process Router, drives traffic,
+SIGKILLs one replica mid-load, and asserts the plane's contract:
+
+  - every post-kill request answers 200 (zero client-visible 5xx);
+  - the dead replica's breaker opens within one probe round;
+  - the flight ring records breaker_open / router_failover, dumped to
+    --out so `lumina events --type breaker_open <out>` replays it.
+
+CPU-only, stdlib HTTP, synthetic engine — no model weights, no device.
+CI runs it as the "router smoke (multi-process)" step in test.yml.
+
+Usage:
+  python scripts/router_smoke.py [--out routersmoke] [--requests 8]
+  python scripts/router_smoke.py --replica --port 18011   (child mode)
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_engine():
+    """Host-only synthetic engine speaking GenerationEngine's contract."""
+    from luminaai_tpu.config import Config
+
+    class _TokBackend:
+        def encode(self, text):
+            return [ord(c) % 250 for c in text]
+
+    class _Tok:
+        backend = _TokBackend()
+
+        def decode(self, tokens):
+            return "tok:" + ",".join(str(t) for t in tokens)
+
+    class _Eng:
+        def __init__(self):
+            self.config = Config(
+                vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+                num_kv_heads=2, seq_length=64, use_flash_attention=False,
+            )
+            self.tokenizer = _Tok()
+
+        def generate(self, prompt_tokens, **kw):
+            toks = list(prompt_tokens)[:4]
+            return toks, {"tokens_generated": len(toks), "stopped": "eos"}
+
+        def generate_batch(self, prompts, **kw):
+            return [self.generate(p, **kw) for p in prompts]
+
+        def encode_chat(self, messages):
+            return self.tokenizer.backend.encode(messages[-1]["content"])
+
+        def generate_stream(self, prompt_tokens, **kw):
+            toks, stats = self.generate(prompt_tokens, **kw)
+            yield from toks
+            yield stats
+
+    return _Eng()
+
+
+def replica_main(port: int) -> int:
+    from http.server import ThreadingHTTPServer
+
+    from luminaai_tpu.monitoring.telemetry import MetricsRegistry
+    from luminaai_tpu.serving.server import ChatServer
+
+    srv = ChatServer(build_engine(), registry=MetricsRegistry())
+    httpd = ThreadingHTTPServer(("127.0.0.1", port), srv.make_handler())
+    print(f"replica serving on {port}", flush=True)
+    httpd.serve_forever()
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--replica", action="store_true")
+    ap.add_argument("--port", type=int, default=18011)
+    ap.add_argument("--out", default="routersmoke")
+    ap.add_argument("--requests", type=int, default=8)
+    args = ap.parse_args()
+    if args.replica:
+        return replica_main(args.port)
+
+    from luminaai_tpu.monitoring.events import FlightRecorder
+    from luminaai_tpu.monitoring.telemetry import MetricsRegistry
+    from luminaai_tpu.serving.router import Router, wait_ready
+    from luminaai_tpu.testing.faults import kill_replica
+
+    ports = [args.port, args.port + 1]
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    children = [
+        subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--replica", "--port", str(p)],
+            env=env,
+        )
+        for p in ports
+    ]
+    failures = []
+    try:
+        wait_ready(urls, timeout_s=120)
+        recorder = FlightRecorder(capacity=2048)
+        router = Router(
+            list(zip(("r0", "r1"), urls)),
+            registry=MetricsRegistry(), recorder=recorder,
+            max_failovers=1, breaker_cooldown_s=5.0,
+        )
+        router.probe_all()
+
+        def drive(n, tag):
+            ok = 0
+            for i in range(n):
+                status, payload = router.dispatch(
+                    "/v1/generate", {"prompt": f"{tag} {i}"})
+                if status == 200:
+                    ok += 1
+                else:
+                    failures.append(f"{tag} {i}: http {status}: {payload}")
+            return ok
+
+        warm_ok = drive(args.requests, "warm")
+        if warm_ok != args.requests:
+            failures.append(f"warm phase: {warm_ok}/{args.requests} ok")
+
+        # Real SIGKILL, mid-load: no FIN, no drain, sockets just die.
+        kill_replica(children[1])
+        children[1].wait(timeout=30)
+        killed_ok = drive(args.requests, "post-kill")
+        if killed_ok != args.requests:
+            failures.append(
+                f"post-kill phase: {killed_ok}/{args.requests} ok "
+                "(client-visible failure after replica death)"
+            )
+        router.probe_all()  # one probe round must open the breaker
+        state = router.replicas[1].breaker.state
+        if state != "open":
+            failures.append(f"breaker after probe: {state} (want open)")
+        after_ok = drive(4, "post-probe")
+        if after_ok != 4:
+            failures.append(f"post-probe phase: {after_ok}/4 ok")
+
+        dump = recorder.dump_to_dir(args.out, reason="router_smoke")
+        summary = {
+            "replicas": 2,
+            "warm_ok": warm_ok,
+            "post_kill_ok": killed_ok,
+            "post_probe_ok": after_ok,
+            "breaker_r1": state,
+            "failovers": len(recorder.snapshot(type="router_failover")),
+            "breaker_open_events": len(
+                recorder.snapshot(type="breaker_open")),
+            "dump": dump,
+            "failures": failures,
+        }
+        print(json.dumps(summary))
+        return 1 if failures else 0
+    finally:
+        for c in children:
+            if c.poll() is None:
+                c.terminate()
+        deadline = time.monotonic() + 15
+        for c in children:
+            try:
+                c.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                c.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
