@@ -1125,6 +1125,145 @@ def _serve_bench_main(smoke: bool) -> None:
         sys.exit(1)
 
 
+def _page_share_extras(smoke: bool) -> dict:
+    """extras.page_share for the router bench (ISSUE 20): a shared-
+    prefix workload priced cache-on vs cache-off — replica B pulls
+    replica A's harvested pages through the real PageShareClient fetch
+    path (loopback seams, no sockets) and every later admission rides
+    the splice. Reports the cross-replica hit rate and summed prefill
+    seconds both ways. Unlike the routing rungs this one needs jax (a
+    real tiny model on CPU): the quantity measured is admission-side
+    prefill compute actually avoided, which a synthetic engine cannot
+    exhibit."""
+    try:
+        import jax
+        import jax.numpy as jnp
+        from flax import linen as nn
+
+        from luminaai_tpu.config import Config
+        from luminaai_tpu.data.tokenizer import ConversationTokenizer
+        from luminaai_tpu.inference.generate import GenerationEngine
+        from luminaai_tpu.models.transformer import LuminaTransformer
+        from luminaai_tpu.serving.page_share import PageShareClient
+
+        tok = ConversationTokenizer()
+        cfg = Config(
+            vocab_size=tok.vocab_size, hidden_size=64, num_layers=2,
+            num_heads=1, num_kv_heads=1, seq_length=256,
+            use_flash_attention=False, precision="fp32",
+            gradient_checkpointing=False, max_new_tokens=4,
+            prefill_chunk_size=32, attention_backend="ragged_xla",
+        )
+        model = LuminaTransformer(cfg)
+        params = model.init(
+            jax.random.key(0), jnp.ones((1, 8), jnp.int32)
+        )["params"]
+        params = jax.tree.map(
+            lambda x: (
+                x.unbox() if isinstance(x, nn.meta.AxisMetadata) else x
+            ),
+            params, is_leaf=lambda x: isinstance(x, nn.meta.AxisMetadata),
+        )
+        engine = GenerationEngine(model, params, tok, cfg)
+
+        def mk(cache):
+            kw = dict(num_slots=2, page_size=32, max_slot_tokens=192)
+            if cache:
+                kw["prefix_cache_pages"] = 6
+            return engine.make_stepwise(**kw)
+
+        class _Loopback(PageShareClient):
+            """Router + owner conversations short-circuited onto the
+            in-process owner decoder; fetch_page stays the real code."""
+
+            def __init__(self, owner):
+                super().__init__(
+                    "http://router:0", self_url="http://b:1",
+                    timeout_s=10.0,
+                )
+                self.owner = owner
+
+            def lookup(self, keys, have=0):
+                idx = self.owner.prefix_cache._index
+                owned = []
+                for k in keys:
+                    if k not in idx:
+                        break
+                    owned.append(k)
+                if len(owned) <= have:
+                    return None, []
+                return "http://a:0", owned
+
+            def get_bytes(self, base_url, path, timeout_s=None):
+                key = path.rsplit("/", 1)[1]
+                pid = self.owner.prefix_cache.pin_key(key)
+                if pid is None:
+                    return 404, b""
+                try:
+                    if pid in self.owner._queued_dst:
+                        return 404, b""
+                    return 200, self.owner.pool.export_page(pid)
+                finally:
+                    self.owner.prefix_cache.release([pid])
+
+        shared = tok.encode_text(
+            "the quick brown fox jumps over the lazy dog " * 3
+        )[:96]
+        n = 3 if smoke else 8
+        prompts = [
+            shared + tok.encode_text(f"suffix {i}") for i in range(n)
+        ]
+        warm = tok.encode_text("warmup pass only " * 8)[:80]
+
+        def admit(dec, prompt):
+            s = dec.acquire_slot()
+            t0 = time.perf_counter()
+            st = dec.start_prefill(s, prompt, max_new_tokens=1)
+            info = None
+            while info is None:
+                info = dec.advance_prefill(st)
+            dt = time.perf_counter() - t0
+            dec.release_slot(s)
+            return dt, info
+
+        # One warm admission per decoder: compile outside the clock.
+        dec_off = mk(cache=False)
+        admit(dec_off, warm)
+        off_s = sum(admit(dec_off, p)[0] for p in prompts)
+
+        dec_a = mk(cache=True)
+        admit(dec_a, warm)
+        admit(dec_a, prompts[0])  # A computes + harvests the prefix
+        dec_a.flush_harvests()
+        dec_b = mk(cache=True)
+        admit(dec_b, warm)
+        dec_b.page_share = _Loopback(dec_a)
+        on_s, hits, saved = 0.0, 0, 0
+        for p in prompts:
+            dt, info = admit(dec_b, p)
+            on_s += dt
+            pages = int(info["prefix"]["hit_pages"])
+            if pages:
+                hits += 1
+            saved += pages * 32
+        tokens_off = sum(len(p) for p in prompts)
+        return {
+            "requests": n,
+            "cross_replica_hit_rate": round(hits / n, 3),
+            "remote_hit_admissions": dec_b.remote_hits,
+            "pull_failures": dec_b.remote_pull_failures,
+            "prefill_seconds_cache_on": round(on_s, 4),
+            "prefill_seconds_cache_off": round(off_s, 4),
+            # Wall seconds on a toy CPU model undersell the win (the
+            # pull roundtrip is fixed cost, prefill compute is ~free);
+            # token counts carry the compute actually avoided.
+            "prefill_tokens_cache_on": tokens_off - saved,
+            "prefill_tokens_cache_off": tokens_off,
+        }
+    except Exception as e:  # nested: the routing rungs stand on their own
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _router_bench_main(smoke: bool) -> None:
     """Serving-plane router bench: a 2-replica local fleet behind the
     data-plane router (serving/router.py), then the kill-one-replica
@@ -1330,6 +1469,7 @@ def _router_bench_main(smoke: bool) -> None:
                         for r in router.replicas
                     },
                 },
+                "page_share": _page_share_extras(smoke),
             },
         )
         if routed_ok != n_routed:

@@ -629,17 +629,20 @@ def cmd_evaluate(args) -> int:
 
 def _fleet_child_argv(argv: List[str], port: int) -> List[str]:
     """Rebuild a replica's serve argv from the parent's: same flags,
-    its own port, no --replicas (a replica must not recurse)."""
+    its own port, no --replicas (a replica must not recurse). The
+    page-share wiring flags are stripped too — the fleet parent
+    re-issues them pointing at its own router."""
+    drop = ("--replicas", "--port", "--page-share", "--page-share-self")
     out: List[str] = []
     skip = False
     for a in argv:
         if skip:
             skip = False
             continue
-        if a in ("--replicas", "--port"):
+        if a in drop:
             skip = True
             continue
-        if a.startswith("--replicas=") or a.startswith("--port="):
+        if any(a.startswith(d + "=") for d in drop):
             continue
         out.append(a)
     return out + ["--port", str(port)]
@@ -660,11 +663,20 @@ def _serve_fleet(args) -> int:
     ports = [args.port + 1 + i for i in range(n)]
     urls = [f"http://{args.host}:{p}" for p in ports]
     procs = []
+    router_url = f"http://{args.host}:{args.port}"
     try:
         for p in ports:
+            child = _fleet_child_argv(sys.argv[1:], p)
+            # Auto-wire cross-replica page sharing: every replica
+            # reports its harvested prefix keys to the fleet router and
+            # can pull pages from siblings (docs/serving.md
+            # "Cross-replica prefix sharing").
+            child += [
+                "--page-share", router_url,
+                "--page-share-self", f"http://{args.host}:{p}",
+            ]
             procs.append(subprocess.Popen(
-                [sys.executable, "-m", "luminaai_tpu"]
-                + _fleet_child_argv(sys.argv[1:], p)
+                [sys.executable, "-m", "luminaai_tpu"] + child
             ))
         print(f"fleet: {n} replica(s) on ports {ports}; waiting for "
               "warmup...", file=sys.stderr)
@@ -784,6 +796,12 @@ def cmd_serve(args) -> int:
         slo=not getattr(args, "no_slo", False),
         slo_config=getattr(args, "slo_config", None),
         healthz_stale_after_s=getattr(args, "healthz_stale_after", None),
+        page_share=getattr(args, "page_share", None),
+        page_share_self_url=getattr(args, "page_share_self", None),
+        page_pull_timeout_s=getattr(args, "page_pull_timeout", None) or 2.0,
+        page_share_max_inflight=(
+            getattr(args, "page_share_max_inflight", None) or 2
+        ),
     )
     return 0
 
@@ -2003,6 +2021,29 @@ def build_parser() -> argparse.ArgumentParser:
                          "port+1..port+N) fronted by the replica "
                          "router on --port — the one-command dev "
                          "fleet (docs/serving.md 'Replica router')")
+    sv.add_argument("--page-share", dest="page_share", default=None,
+                    help="router URL for cross-replica KV page sharing: "
+                         "report harvested prefix-chain keys there and "
+                         "pull indexed pages from sibling replicas on "
+                         "cold admissions (--replicas wires this "
+                         "automatically; docs/serving.md 'Cross-replica "
+                         "prefix sharing')")
+    sv.add_argument("--page-share-self", dest="page_share_self",
+                    default=None,
+                    help="this replica's own base URL, as siblings "
+                         "should reach it for GET /pages/<key> "
+                         "(required for reporting; --replicas sets it)")
+    sv.add_argument("--page-pull-timeout", dest="page_pull_timeout",
+                    type=float, default=None,
+                    help="seconds one whole remote page pull may take "
+                         "(lookup + transfers) before the admission "
+                         "degrades to local prefill (default 2)")
+    sv.add_argument("--page-share-max-inflight",
+                    dest="page_share_max_inflight", type=int,
+                    default=None,
+                    help="max concurrent remote page pulls per replica "
+                         "(default 2); further cold admissions just "
+                         "prefill locally")
     sv.set_defaults(fn=cmd_serve)
 
     rt = sub.add_parser(

@@ -431,6 +431,17 @@ class Config:
     router_max_failovers: int = 2
     router_hedge_budget: float = 0.1
     router_hedge_max_tokens: int = 32
+    # Cross-replica KV page sharing (docs/serving.md "Cross-replica
+    # prefix sharing"): replicas report harvested prefix-chain keys to
+    # the router's page index and pull indexed pages directly from the
+    # owning sibling on a cold admission. page_share enables the plane
+    # (the serve CLI takes the router URL); page_pull_timeout_s bounds
+    # one whole pull (lookup + transfers) before degrading to local
+    # prefill; page_share_max_inflight caps concurrent pulls per
+    # replica so transfers can't starve the decode loop.
+    page_share: bool = False
+    page_pull_timeout_s: float = 2.0
+    page_share_max_inflight: int = 2
 
     # --- Adaptive control (orchestrator) ---
     enable_adaptive_lr: bool = True
@@ -637,6 +648,12 @@ class Config:
         )
         assert self.router_hedge_max_tokens >= 1, (
             "router_hedge_max_tokens must be >= 1"
+        )
+        assert self.page_pull_timeout_s > 0, (
+            "page_pull_timeout_s must be positive"
+        )
+        assert self.page_share_max_inflight >= 1, (
+            "page_share_max_inflight must be >= 1"
         )
         if self.use_moe:
             assert self.moe_top_k <= self.num_experts, "moe_top_k must be <= num_experts"

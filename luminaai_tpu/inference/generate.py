@@ -1215,8 +1215,26 @@ class StepwiseDecoder:
         # refcount-pinned; _arm_prefill flushes before any acquire so a
         # hit can never splice a page whose bytes have not landed.
         self._harvest_queue: List[Tuple[int, int]] = []
+        # Arena dst pages whose harvest copy has NOT executed yet (a
+        # superset of _harvest_queue's dst column, cleared only after
+        # pool.caches actually carries the bytes). The page-export HTTP
+        # path refuses these so a remote puller can never receive a
+        # page whose copy is still queued or mid-flight.
+        self._queued_dst: set = set()
         self.harvest_copy_calls = 0
         self.harvest_flushes = 0
+        # Cross-replica page plane (ISSUE 20): the scheduler injects a
+        # serving/page_share.PageShareClient here; start_prefill then
+        # consults the fleet index for chains resident on another
+        # replica and imports their pages before the local acquire.
+        # _landed_keys accumulates chain keys whose BYTES are arena-
+        # resident (flushed harvest or completed pull) — the scheduler
+        # drains them into ownership reports; keys are never reported
+        # while their copy is still queued.
+        self.page_share = None
+        self._landed_keys: List[str] = []
+        self.remote_hits = 0
+        self.remote_pull_failures = 0
         self._refresh_table()
 
     def _refresh_table(self) -> None:
@@ -1652,6 +1670,17 @@ class StepwiseDecoder:
                 st["wait_ticks"] = 0
                 self._park_lane(slot, 0)
                 return st
+            if len(peek_keys) < len(chain) and self.page_share is not None:
+                # Cold (or partially cold) chain: ask the fleet index
+                # whether another replica already computed these pages
+                # and import them BEFORE the acquire below — a
+                # successful pull turns this admission into a genuine
+                # local hit; any failure leaves it exactly a miss.
+                if self._try_remote_pull(slot, prompt, chain,
+                                         len(peek_keys), st):
+                    peek_keys, _ = self.prefix_cache.lookup(
+                        prompt, keys=chain
+                    )
             if L <= chunk and not peek_keys:
                 return None
         elif L <= chunk:
@@ -1664,6 +1693,99 @@ class StepwiseDecoder:
             return None
         self._arm_prefill(st)
         return st
+
+    def _try_remote_pull(
+        self,
+        slot: int,
+        prompt: Sequence[int],
+        chain: List[str],
+        have: int,
+        st: Dict[str, Any],
+    ) -> int:
+        """Pull this chain's non-resident pages from their fleet owner
+        into the local arena (ISSUE 20 remote-hit admission). Returns
+        pages imported; 0 means "proceed as the plain miss you were".
+
+        Sequence: fleet lookup → pull-slot acquire (bounded, non-
+        blocking) → pending-claim the keys (concurrent same-chain
+        admissions park exactly like behind a local harvest, so N
+        arrivals cost ONE pull) → register arena assignments via the
+        normal insert() path → fetch + import each page IN CHAIN ORDER
+        under one transfer deadline. The import is synchronous inside
+        the admission (single scheduler worker), so no other acquire
+        can splice a page whose bytes have not landed. On a mid-chain
+        failure the already-imported prefix stays (a valid shorter
+        chain); the unwritten tail is released + forgotten, mirroring
+        the flush_harvests failure unwind — transfer failure is never
+        worse than a cache miss."""
+        client = self.page_share
+        cache = self.prefix_cache
+        ps = self.pool.page_size
+        try:
+            owner, owned = client.lookup(chain, have=have)
+        except Exception:  # a sick router must never block admission
+            logger.debug("page-share lookup failed", exc_info=True)
+            return 0
+        if owner is None or len(owned) <= have:
+            return 0
+        if not client.try_begin_pull():
+            return 0
+        deadline = time.monotonic() + client.timeout_s
+        claimed = cache.claim_pending(owned, owner=slot)
+        imported: List[int] = []
+        imported_keys: List[str] = []
+        nbytes = 0
+        failed = False
+        try:
+            assignments = cache.insert(
+                list(prompt[: len(owned) * ps]), from_page=have,
+                tenant=st.get("tenant", "anon"),
+            )
+            if not assignments:
+                return 0
+            cache.pin_pages([pid for _, pid in assignments])
+            try:
+                for j, pid in assignments:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise OSError("page pull deadline exceeded")
+                    payload = client.fetch_page(
+                        owner, chain[j], timeout_s=remaining
+                    )
+                    nbytes += self.pool.import_page(pid, payload)
+                    imported.append(pid)
+                    imported_keys.append(chain[j])
+            except Exception as e:
+                failed = True
+                self.remote_pull_failures += 1
+                logger.warning(
+                    "page pull from %s failed after %d/%d page(s): %s",
+                    owner, len(imported), len(assignments), e,
+                )
+                tail = [
+                    pid for _, pid in assignments if pid not in imported
+                ]
+                cache.release(tail)
+                cache.forget(tail)
+            cache.release(imported)
+            if imported:
+                # The pulled pages are arena-resident here too now:
+                # advertise ownership so the NEXT replica can pull from
+                # whichever owner is closer/live.
+                self._landed_keys.extend(imported_keys)
+            st["remote"] = {
+                "owner": owner,
+                "pulled": len(imported),
+                "tokens": len(imported) * ps,
+                "bytes": nbytes,
+                "failed": failed,
+            }
+            if imported:
+                self.remote_hits += 1
+            return len(imported)
+        finally:
+            cache.release_pending(claimed)
+            client.end_pull()
 
     def _park_lane(self, slot: int, rows: int) -> None:
         """Interleaved decode steps still write one (garbage) row at
@@ -1804,6 +1926,10 @@ class StepwiseDecoder:
                 "pages_harvested": harvested,
                 "tenant": st.get("tenant", "anon"),
                 "dedup_wait_ticks": int(st.get("wait_ticks", 0)),
+                # Cross-replica pull accounting (None for purely local
+                # admissions): the scheduler books remote-hit counters
+                # and prefix_remote_hit events from this.
+                "remote": st.get("remote"),
             }
         return info
 
@@ -1824,6 +1950,7 @@ class StepwiseDecoder:
             return 0
         P = self.pool.pages
         self.prefix_cache.pin_pages([pid for _, pid in assignments])
+        self._queued_dst.update(pid for _, pid in assignments)
         self._harvest_queue.extend(
             (slot * P + j, pid) for j, pid in assignments
         )
@@ -1867,9 +1994,27 @@ class StepwiseDecoder:
             )
             self.prefix_cache.release([d for _, d in pairs])
             self.prefix_cache.forget([d for _, d in pairs])
+            self._queued_dst.difference_update(d for _, d in pairs)
             return 0
         self.prefix_cache.release([d for _, d in pairs])
+        # Bytes are on device as of the (synchronous) copy above —
+        # only now may the export path serve these pages.
+        self._queued_dst.difference_update(d for _, d in pairs)
+        if self.page_share is not None:
+            # Bytes are arena-resident as of this flush: these keys are
+            # now safely servable to pullers, so queue the ownership
+            # report (the scheduler drains after its flush call).
+            self._landed_keys.extend(
+                self.prefix_cache.keys_for_pages([d for _, d in pairs])
+            )
         return len(pairs)
+
+    def drain_landed_keys(self) -> List[str]:
+        """Chain keys whose page bytes became arena-resident since the
+        last drain (harvest flushes + completed remote pulls). The
+        scheduler reports them to the router's fleet index."""
+        out, self._landed_keys = self._landed_keys, []
+        return out
 
     def _get_copy_pages(self, K: int):
         """Jitted bulk page copy: K (src, dst) GLOBAL page id pairs moved

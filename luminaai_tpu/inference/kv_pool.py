@@ -22,10 +22,55 @@ layer's (models/layers.py per-lane cache update).
 
 from __future__ import annotations
 
+import json
+import math
 import threading
 from typing import Any, List, Optional
 
 import numpy as np
+
+# Wire format for one serialized KV page (cross-replica page pulls,
+# ISSUE 20): magic, 4-byte big-endian header length, JSON header
+# {"page_size": int, "leaves": [{"shape": [...], "dtype": "..."}]},
+# then each leaf's C-order bytes concatenated in tree-flatten order.
+# int8 pools need no special casing — codes and scales are separate
+# tree leaves and each frames its own slice.
+PAGE_WIRE_MAGIC = b"LPG1"
+
+
+def parse_page_payload(payload: bytes) -> List[np.ndarray]:
+    """Decode a PAGE_WIRE_MAGIC-framed payload into per-leaf numpy
+    slices (tree-flatten order). Raises ValueError on any framing
+    mismatch — truncated, trailing, or mislabeled bytes must never
+    reach the device arena."""
+    if payload[:4] != PAGE_WIRE_MAGIC:
+        raise ValueError("bad page payload magic")
+    if len(payload) < 8:
+        raise ValueError("truncated page payload header")
+    hlen = int.from_bytes(payload[4:8], "big")
+    try:
+        header = json.loads(payload[8:8 + hlen].decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ValueError(f"bad page payload header: {e}") from e
+    off = 8 + hlen
+    out: List[np.ndarray] = []
+    for meta in header.get("leaves", []):
+        try:
+            dt = np.dtype(meta["dtype"])
+        except TypeError:
+            import ml_dtypes  # noqa: F401  registers bfloat16 et al.
+
+            dt = np.dtype(meta["dtype"])
+        shape = tuple(int(d) for d in meta["shape"])
+        n = math.prod(shape) * dt.itemsize
+        buf = payload[off:off + n]
+        if len(buf) != n:
+            raise ValueError("truncated page payload body")
+        out.append(np.frombuffer(buf, dtype=dt).reshape(shape))
+        off += n
+    if off != len(payload):
+        raise ValueError("trailing bytes after page payload")
+    return out
 
 
 def to_paged(tree, pages: int, page_size: int):
@@ -180,6 +225,90 @@ class PagedKVPool:
         kernel, in the dtype it wants on device."""
         with self._lock:
             return self.lengths.astype(np.int32)
+
+    # -- cross-replica page serialization (ISSUE 20) ---------------------
+    def _locate(self, gid: int):
+        """Global page id -> physical (slot, page). Bounds-checked
+        against the PHYSICAL slot axis of the cache tree, not
+        `num_slots`: the prefix-cache arena lives in extra slots past
+        the lane pool (generate.py carves them out as
+        total_slots > num_slots), and arena pages are exactly what the
+        cross-replica tier exports and imports."""
+        import jax
+
+        slot, page = divmod(int(gid), self.pages)
+        physical = jax.tree.leaves(self.caches)[0].shape[-5]
+        if not (0 <= slot < physical):
+            raise ValueError(
+                f"page id {gid} outside pool "
+                f"({physical} physical slots x {self.pages} pages)"
+            )
+        return slot, page
+
+    def export_page(self, gid: int) -> bytes:
+        """Serialize ONE physical page (global id = slot * pages + page)
+        into a framed host payload: every KV leaf's [page_size, heads,
+        dim] slice (plus any leading scan_layers axes), device_get'd
+        here — the transfer tier runs OFF the decode hot path, never
+        inside a jitted step. int8 pools carry codes AND scales because
+        both are tree leaves of the same paged layout."""
+        import jax
+
+        if self.caches is None:
+            raise RuntimeError("accounting-only pool has no cache tree")
+        slot, page = self._locate(gid)
+        metas, blobs = [], []
+        for leaf in jax.tree.leaves(self.caches):
+            # slot axis at ndim-5, page axis at ndim-4 (the ellipsis
+            # absorbs scan_layers' leading segment axis when present).
+            arr = np.ascontiguousarray(
+                jax.device_get(leaf[..., slot, page, :, :, :])
+            )
+            metas.append({"shape": list(arr.shape),
+                          "dtype": str(arr.dtype)})
+            blobs.append(arr.tobytes())
+        header = json.dumps(
+            {"page_size": self.page_size, "leaves": metas}
+        ).encode("utf-8")
+        return b"".join(
+            [PAGE_WIRE_MAGIC, len(header).to_bytes(4, "big"), header]
+            + blobs
+        )
+
+    def import_page(self, gid: int, payload: bytes) -> int:
+        """Write a pulled page's bytes into physical page `gid`
+        (device_put off the hot path). Every leaf slice is validated
+        against this pool's layout BEFORE the tree is touched — a
+        mismatched payload (different model geometry, different
+        kv_cache_dtype) raises instead of corrupting the arena.
+        Returns the payload size in bytes for transfer accounting."""
+        import jax
+
+        if self.caches is None:
+            raise RuntimeError("accounting-only pool has no cache tree")
+        slot, page = self._locate(gid)
+        arrs = parse_page_payload(payload)
+        leaves, treedef = jax.tree.flatten(self.caches)
+        if len(arrs) != len(leaves):
+            raise ValueError(
+                f"page payload has {len(arrs)} leaves, pool has "
+                f"{len(leaves)}"
+            )
+        for arr, leaf in zip(arrs, leaves):
+            want_shape = tuple(leaf.shape[:-5]) + tuple(leaf.shape[-3:])
+            if tuple(arr.shape) != want_shape or (
+                np.dtype(arr.dtype) != np.dtype(leaf.dtype)
+            ):
+                raise ValueError(
+                    f"page leaf mismatch: got {arr.shape}/{arr.dtype}, "
+                    f"pool wants {want_shape}/{np.dtype(leaf.dtype)}"
+                )
+        new = [
+            leaf.at[..., slot, page, :, :, :].set(arr)
+            for arr, leaf in zip(arrs, leaves)
+        ]
+        self.caches = jax.tree.unflatten(treedef, new)
+        return len(payload)
 
     # -- occupancy accounting (telemetry) --------------------------------
     def pages_in_use(self) -> int:

@@ -219,6 +219,33 @@ class RadixPrefixCache:
                 if ent is not None:
                     ent.refs += 1
 
+    def pin_key(self, key: str) -> Optional[int]:
+        """Refcount-pin ONE cached page by chain key, returning its
+        arena page id (None when not resident). The cross-replica page
+        export path (`GET /pages/<key>`) pins the page for the duration
+        of the device_get so eviction pressure can never reassign the
+        arena slot mid-serialization; release([page_id]) unpins."""
+        with self._lock:
+            ent = self._index.get(key)
+            if ent is None:
+                return None
+            ent.refs += 1
+            self._clock += 1
+            ent.last_use = self._clock
+            return ent.page_id
+
+    def keys_for_pages(self, page_ids: Sequence[int]) -> List[str]:
+        """Chain keys currently backing these arena page ids (unknown
+        ids are skipped). The scheduler maps a flushed harvest's dst
+        pages back to keys to report fleet-index ownership."""
+        with self._lock:
+            out: List[str] = []
+            for pid in page_ids:
+                key = self._by_page.get(int(pid))
+                if key is not None and key in self._index:
+                    out.append(key)
+            return out
+
     # -- insert / evict ----------------------------------------------------
     def _evictable(self, tenant: Optional[str] = None) -> List[_CachedPage]:
         ents = [

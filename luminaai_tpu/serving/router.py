@@ -393,6 +393,7 @@ class Router:
         hedge_max_tokens: int = 32,
         affinity_prefix_chars: int = 256,
         flight_dir: Optional[str] = None,
+        page_index_capacity: int = 65536,
     ):
         self.transport = transport or HttpTransport()
         self._clock = clock
@@ -485,6 +486,33 @@ class Router:
         self._m_available = self.registry.gauge(
             "router_replicas_available",
             "Replicas currently accepting new admissions",
+        )
+
+        # Fleet page index (ISSUE 20): chain key -> owning replica URL,
+        # fed by replica harvest reports (POST /pages/report) and read
+        # by replica cold admissions (POST /pages/lookup). Keys and
+        # URLs only — page BYTES move replica-to-replica. FIFO-bounded:
+        # a lost entry costs one missed sharing opportunity, and a
+        # stale one costs one failed pull that degrades to a local
+        # recompute, so the index needs no consistency protocol.
+        self.page_index_capacity = max(0, int(page_index_capacity))
+        self._page_index: "collections.OrderedDict[str, str]" = (
+            collections.OrderedDict()
+        )
+        self._page_reports: Dict[str, int] = {}
+        self._page_lock = threading.Lock()
+        self._m_page_index = self.registry.gauge(
+            "router_page_index_keys",
+            "Chain keys currently in the fleet page index",
+        )
+        self._m_page_reports = self.registry.counter(
+            "router_page_reports_total",
+            "Chain-key ownership reports accepted into the fleet index",
+        )
+        self._m_page_lookups = self.registry.counter(
+            "router_page_lookups_total",
+            "Fleet page-index lookups, by result",
+            labelnames=("result",),
         )
 
         self.replicas: List[Replica] = []
@@ -603,20 +631,94 @@ class Router:
         if self._probe_stop is not None:
             self._probe_stop.set()
 
+    # -- fleet page index (ISSUE 20) ---------------------------------------
+    def _replica_by_url(self, url: str) -> Optional[Replica]:
+        url = str(url).rstrip("/")
+        for r in self.replicas:
+            if r.url.rstrip("/") == url:
+                return r
+        return None
+
+    def handle_page_report(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        """POST /pages/report core: a replica advertises chain keys
+        whose page bytes are arena-resident on it. Only registered
+        replicas are indexed (an unknown URL could otherwise poison
+        every lookup); last reporter wins per key."""
+        url = str(body.get("replica", "")).rstrip("/")
+        keys = [
+            k for k in (body.get("keys") or [])
+            if isinstance(k, str) and k
+        ]
+        if self._replica_by_url(url) is None:
+            return {"indexed": 0, "known": False}
+        with self._page_lock:
+            for key in keys:
+                self._page_index[key] = url
+                self._page_index.move_to_end(key)
+            while len(self._page_index) > self.page_index_capacity:
+                self._page_index.popitem(last=False)
+            self._page_reports[url] = (
+                self._page_reports.get(url, 0) + len(keys)
+            )
+            self._m_page_index.set(len(self._page_index))
+        if keys:
+            self._m_page_reports.inc(len(keys))
+        return {"indexed": len(keys), "known": True}
+
+    def handle_page_lookup(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        """POST /pages/lookup core: given a chain (and how many leading
+        pages the asker already holds), name ONE live replica owning a
+        contiguous run from position `have`, plus the covered prefix of
+        the chain. Owners that are down, draining, breaker-open or the
+        asker itself are invisible — a lookup must never send a puller
+        at a replica the router would not route a request to."""
+        keys = [
+            k for k in (body.get("keys") or [])
+            if isinstance(k, str) and k
+        ]
+        exclude = str(body.get("exclude", "")).rstrip("/")
+        have = max(0, min(int(body.get("have", 0) or 0), len(keys)))
+        if have >= len(keys):
+            self._m_page_lookups.labels(result="miss").inc()
+            return {"owner": None, "keys": []}
+        with self._page_lock:
+            owner = self._page_index.get(keys[have])
+        rep = self._replica_by_url(owner) if owner else None
+        if (
+            owner is None
+            or owner == exclude
+            or rep is None
+            or rep.status in ("down", "draining", "warming")
+            or rep.breaker.state == "open"
+        ):
+            self._m_page_lookups.labels(result="miss").inc()
+            return {"owner": None, "keys": []}
+        matched = list(keys[:have + 1])
+        with self._page_lock:
+            for key in keys[have + 1:]:
+                if self._page_index.get(key) != owner:
+                    break
+                matched.append(key)
+        self._m_page_lookups.labels(result="hit").inc()
+        return {"owner": owner, "keys": matched}
+
+    def _page_index_counts(self) -> Dict[str, int]:
+        with self._page_lock:
+            counts: Dict[str, int] = {}
+            for url in self._page_index.values():
+                counts[url] = counts.get(url, 0) + 1
+            return counts
+
     # -- candidate selection -----------------------------------------------
     def _affinity_key(self, path: str, body: Dict[str, Any]) -> str:
         """The prompt prefix is the cache identity: requests sharing a
         system prompt / few-shot template hash together, landing where
-        the radix cache already holds their pages."""
-        if "prompt" in body:
-            text = str(body.get("prompt", ""))
-        else:
-            msgs = body.get("messages")
-            if isinstance(msgs, list) and msgs:
-                text = json.dumps(msgs[0], sort_keys=True, default=str)
-            else:
-                text = str(body.get("message", ""))
-        return path + "\x00" + text[: self.affinity_prefix_chars]
+        the radix cache already holds their pages. The keying rule
+        itself lives in serving/page_share.py (single source of truth,
+        shared with the cache's chain ownership — ISSUE 20)."""
+        from luminaai_tpu.serving.page_share import affinity_key
+
+        return affinity_key(path, body, self.affinity_prefix_chars)
 
     def _ordered(self, key: str) -> List[Replica]:
         """Affine target first (rendezvous hash: stable under fleet
@@ -1045,6 +1147,7 @@ class Router:
         """Per-replica verdict table (GET /fleet; rendered by
         `lumina top --url <router>`)."""
         now = self._clock()
+        page_counts = self._page_index_counts()
         reps = []
         for r in self.replicas:
             slo_summary = None
@@ -1068,6 +1171,12 @@ class Router:
                 "shed_for_s": round(max(0.0, r.shed_until - now), 3),
                 "p95_s": round(p95, 4) if p95 is not None else None,
                 "slo": slo_summary,
+                # Shared-index view: chain keys the fleet index credits
+                # to this replica + how many it has ever reported.
+                "shared_pages": page_counts.get(r.url.rstrip("/"), 0),
+                "page_reports": self._page_reports.get(
+                    r.url.rstrip("/"), 0
+                ),
             })
         code, health = self.health_payload()
         return {**health, "http_status": code, "replicas": reps}
@@ -1128,7 +1237,8 @@ class Router:
 
             def do_POST(self):
                 path = self.path.split("?", 1)[0]
-                if path not in ("/v1/generate", "/v1/chat"):
+                if path not in ("/v1/generate", "/v1/chat",
+                                "/pages/report", "/pages/lookup"):
                     self._reply(404, {"error": f"no route POST {path}"})
                     return
                 try:
@@ -1141,6 +1251,12 @@ class Router:
                         raise ValueError("body must be a JSON object")
                 except (ValueError, json.JSONDecodeError) as e:
                     self._reply(400, {"error": f"bad request: {e}"})
+                    return
+                if path == "/pages/report":
+                    self._reply(200, router.handle_page_report(body))
+                    return
+                if path == "/pages/lookup":
+                    self._reply(200, router.handle_page_lookup(body))
                     return
                 headers = self._headers()
                 try:

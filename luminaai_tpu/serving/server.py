@@ -86,6 +86,10 @@ MAX_BODY_BYTES = 1 << 20  # 1MB request cap (input_validator also re-checks)
 # ids are 12 hex chars; anything else sane is fine, garbage is not).
 REQUEST_ID_RX = re.compile(r"[A-Za-z0-9_-]{1,64}")
 
+# Chain keys are sha256 hex (inference/prefix_cache.page_chain_keys);
+# the page-export route rejects anything else before touching the cache.
+PAGE_KEY_RX = re.compile(r"[0-9a-f]{64}")
+
 
 def new_request_id() -> str:
     """Per-request correlation id: short enough for log lines and SSE
@@ -307,6 +311,7 @@ class ContinuousScheduler:
         prefix_cache_tenant_quota: Optional[int] = None,
         tenant_weights: Optional[Dict[str, int]] = None,
         watchdog: Optional[HangWatchdog] = None,
+        page_share=None,
     ):
         self.engine = engine
         # Hang watchdog (monitoring/watchdog.py): armed per generation,
@@ -340,6 +345,15 @@ class ContinuousScheduler:
                 kw["prefix_cache_tenant_quota"] = prefix_cache_tenant_quota
             decoder = engine.make_stepwise(**kw)
         self.decoder = decoder
+        # Cross-replica page plane (serving/page_share.py): inject the
+        # client into the decoder so cold admissions consult the fleet
+        # index; only meaningful when the decoder actually has a prefix
+        # cache to land pulled pages in.
+        self.page_share = page_share
+        if page_share is not None and (
+            getattr(decoder, "prefix_cache", None) is not None
+        ):
+            decoder.page_share = page_share
         # Whether the decoder's chunked admission accepts the tenant
         # rider (the prefix cache attributes pages per tenant).
         try:
@@ -533,6 +547,11 @@ class ContinuousScheduler:
             "serve_prefill_tokens_saved_total",
             "Prompt tokens whose prefill was skipped via cached prefix "
             "pages",
+        )
+        self._m_prefix_remote_hits = r.counter(
+            "serve_prefix_remote_hits_total",
+            "Admissions whose prefix hit rode pages pulled from another "
+            "replica (cross-replica page sharing)",
         )
         # Tenant-keyed cache residency rides under the same label budget
         # as every other tenant series (`lumina analyze` LX009 enforces
@@ -973,6 +992,18 @@ class ContinuousScheduler:
                     pages=int(prefix["hit_pages"]),
                     tokens_saved=int(prefix.get("tokens_saved", 0)),
                 )
+            remote = prefix.get("remote")
+            if isinstance(remote, dict) and remote.get("pulled"):
+                if self.telemetry:
+                    self._m_prefix_remote_hits.inc()
+                self._event(
+                    "prefix_remote_hit", req, slot=slot,
+                    owner=remote.get("owner"),
+                    pages=int(remote.get("pulled", 0)),
+                    tokens=int(remote.get("tokens", 0)),
+                    bytes=int(remote.get("bytes", 0)),
+                    degraded=bool(remote.get("failed")),
+                )
         req.slot = slot
         req.prompt_tokens = int(info.get("prompt_tokens", 0))
         req.admitted_step = int(getattr(self.decoder, "steps", 0))
@@ -1009,10 +1040,18 @@ class ContinuousScheduler:
     def _flush_harvests(self) -> None:
         """One bulk device copy for every harvest queued this tick
         (StepwiseDecoder.flush_harvests; no-op without a prefix cache
-        or an empty queue)."""
+        or an empty queue). With page sharing on, chain keys whose
+        bytes just landed (this flush or a remote pull) are reported
+        to the router's fleet index off-thread."""
         flush = getattr(self.decoder, "flush_harvests", None)
         if flush is not None:
             flush()
+        if self.page_share is not None:
+            drain = getattr(self.decoder, "drain_landed_keys", None)
+            if drain is not None:
+                keys = drain()
+                if keys:
+                    self.page_share.report_async(keys)
 
     def _advance_prefills(self, active: dict) -> None:
         """Advance ONE chunk of ONE mid-prefill admission (round-robin
@@ -1342,6 +1381,10 @@ class ChatServer:
         slo: bool = True,
         slo_config: Optional[str] = None,
         healthz_stale_after_s: Optional[float] = None,
+        page_share: Optional[str] = None,
+        page_share_self_url: Optional[str] = None,
+        page_pull_timeout_s: float = 2.0,
+        page_share_max_inflight: int = 2,
     ):
         self.engine = engine
         self.telemetry = bool(telemetry)
@@ -1407,6 +1450,26 @@ class ChatServer:
                 (k if k == ANON_TENANT else tenant_hash(str(k))): v
                 for k, v in (tenant_weights or {}).items()
             }
+            # Cross-replica page sharing (serving/page_share.py):
+            # `page_share` is the ROUTER url; the client reports
+            # harvested chain keys there and pulls indexed pages
+            # replica-to-replica. self_url is how peers reach THIS
+            # replica — serve() fills it from host/port; tests binding
+            # port 0 set client.self_url after the listener exists.
+            self.page_share = None
+            if page_share:
+                from luminaai_tpu.serving.page_share import (
+                    PageShareClient,
+                )
+
+                self.page_share = PageShareClient(
+                    router_url=str(page_share),
+                    self_url=page_share_self_url or "",
+                    timeout_s=page_pull_timeout_s,
+                    max_inflight=page_share_max_inflight,
+                    registry=self.registry if telemetry else None,
+                    recorder=self.recorder if telemetry else None,
+                )
             self.batcher = ContinuousScheduler(
                 engine,
                 num_slots=num_slots,
@@ -1424,9 +1487,11 @@ class ChatServer:
                 prefix_cache_tenant_quota=prefix_cache_tenant_quota,
                 tenant_weights=weights,
                 watchdog=self.watchdog,
+                page_share=self.page_share,
             )
         else:
             self.watchdog = None
+            self.page_share = None
             self.batcher = MicroBatcher(
                 engine, max_batch=max_batch, window_ms=batch_window_ms,
                 recorder=self.recorder, telemetry=telemetry,
@@ -2407,6 +2472,34 @@ class ChatServer:
                 close()  # continuous: flags the lane cancelled
 
     # -- socket layer ------------------------------------------------------
+    def export_page_by_key(self, key: str) -> Optional[bytes]:
+        """Serve one cached page's framed bytes for a remote puller
+        (GET /pages/<key>). None = not servable right now (not
+        resident, bytes still in the deferred harvest queue, or no
+        prefix cache) — the puller books a failure and degrades to
+        local prefill, so refusing is always safe. The page is
+        refcount-pinned across the device_get so eviction pressure
+        cannot reassign its arena slot mid-serialization."""
+        decoder = getattr(self.batcher, "decoder", None)
+        cache = getattr(decoder, "prefix_cache", None)
+        pool = getattr(decoder, "pool", None)
+        if cache is None or pool is None or pool.caches is None:
+            return None
+        pid = cache.pin_key(key)
+        if pid is None:
+            return None
+        try:
+            if pid in getattr(decoder, "_queued_dst", ()):
+                # Inserted but the bulk copy has not executed: the
+                # arena bytes are still the previous occupant's.
+                return None
+            return pool.export_page(pid)
+        except Exception:
+            logger.exception("page export failed for %s", key[:16])
+            return None
+        finally:
+            cache.release([pid])
+
     def make_handler(self):
         server = self
 
@@ -2417,7 +2510,7 @@ class ChatServer:
             _KNOWN_ROUTES = (
                 "/", "/chat", "/health", "/healthz", "/metrics",
                 "/metrics/history", "/slo", "/stats",
-                "/v1/generate", "/v1/chat", "/v1/auth",
+                "/v1/generate", "/v1/chat", "/v1/auth", "/pages",
             )
 
             def _count(self, code: int) -> None:
@@ -2426,7 +2519,10 @@ class ChatServer:
                     # scanner probing random routes must not be able to
                     # mint unbounded label cardinality.
                     route = self.path.split("?", 1)[0]
-                    if route not in self._KNOWN_ROUTES:
+                    if route.startswith("/pages/"):
+                        # One label for every per-key page fetch.
+                        route = "/pages"
+                    elif route not in self._KNOWN_ROUTES:
                         route = "<other>"
                     server._m_http.labels(
                         route=route, code=str(code)
@@ -2516,6 +2612,28 @@ class ChatServer:
                     self.send_header("Content-Length", str(len(data)))
                     self.end_headers()
                     self.wfile.write(data)
+                    return
+                if path.startswith("/pages/"):
+                    # Cross-replica page export (serving/page_share.py).
+                    # Raw framed bytes, not JSON: the payload is a KV
+                    # page image, and the puller's parser validates the
+                    # LPG1 frame itself.
+                    key = path[len("/pages/"):]
+                    if not PAGE_KEY_RX.fullmatch(key):
+                        self._reply(404, {"error": "bad page key"})
+                        return
+                    payload = server.export_page_by_key(key)
+                    if payload is None:
+                        self._reply(404, {"error": "page not available"})
+                        return
+                    self._count(200)
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "application/octet-stream"
+                    )
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
                     return
                 code, payload = server.handle(
                     "GET", path, {}, self._token()
@@ -2669,6 +2787,10 @@ def serve(
     slo: bool = True,
     slo_config: Optional[str] = None,
     healthz_stale_after_s: Optional[float] = None,
+    page_share: Optional[str] = None,
+    page_share_self_url: Optional[str] = None,
+    page_pull_timeout_s: float = 2.0,
+    page_share_max_inflight: int = 2,
 ):
     """Build an engine from a checkpoint and serve it (CLI `serve`)."""
     from luminaai_tpu.inference.chat import ChatInterface
@@ -2677,6 +2799,10 @@ def serve(
         checkpoint_dir=checkpoint, quantize=quantize, adapter=adapter,
         kv_cache_dtype=kv_cache_dtype
     )
+    if page_share and not page_share_self_url:
+        # Peers reach this replica at the address it serves on; an
+        # explicit --page-share-self overrides (NAT, name-based LBs).
+        page_share_self_url = f"http://{host}:{port}"
     tracer = NULL_TRACER
     if trace_jsonl or trace_jax:
         tracer = SpanTracer(
@@ -2714,6 +2840,13 @@ def serve(
         slo=slo,
         slo_config=slo_config,
         healthz_stale_after_s=healthz_stale_after_s,
+        # Cross-replica page sharing (--page-share <router-url>): the
+        # replica reports harvested chain keys to the router and pulls
+        # indexed pages from sibling replicas on cold admissions.
+        page_share=page_share,
+        page_share_self_url=page_share_self_url,
+        page_pull_timeout_s=page_pull_timeout_s,
+        page_share_max_inflight=page_share_max_inflight,
         latency_buckets=(
             tuple(latency_buckets)
             if latency_buckets

@@ -420,6 +420,57 @@ def slow_replica(server_or_engine, delay_s: float = 0.2) -> Iterator[dict]:
 
 
 @contextlib.contextmanager
+def drop_page_pulls(client, times: int = 0) -> Iterator[dict]:
+    """Make a PageShareClient's page fetches fail with a connection
+    error — the dead/unreachable owner shape the remote-hit admission
+    must degrade from: the pull books a failure, the admission falls
+    back to local prefill, and the client sees nothing. `times=0`
+    drops every fetch; `times=N` drops the first N then passes
+    through. Yields {'calls', 'dropped'}."""
+    stats = {"calls": 0, "dropped": 0}
+    original = client.fetch_page
+
+    def wrapper(owner_url, key, timeout_s=None):
+        stats["calls"] += 1
+        if times == 0 or stats["dropped"] < times:
+            stats["dropped"] += 1
+            # Book the failure through the client's own accounting so
+            # serve_prefix_remote_pull_failures_total still increments.
+            client._observe_pull(key, owner_url, client._clock(),
+                                 ok=False, nbytes=0)
+            raise OSError("injected page pull drop")
+        return original(owner_url, key, timeout_s=timeout_s)
+
+    client.fetch_page = wrapper
+    try:
+        yield stats
+    finally:
+        _restore(client, "fetch_page", wrapper, original)
+
+
+@contextlib.contextmanager
+def slow_page_pulls(client, delay_s: float = 0.5) -> Iterator[dict]:
+    """Stall every page fetch `delay_s` before it runs — the congested/
+    half-dead owner shape the transfer deadline exists for: with
+    delay_s above the client's timeout budget, the pull chain runs out
+    of deadline partway and the admission degrades to local prefill
+    for the rest. Yields {'calls'}."""
+    stats = {"calls": 0}
+    original = client.fetch_page
+
+    def wrapper(owner_url, key, timeout_s=None):
+        stats["calls"] += 1
+        time.sleep(delay_s)
+        return original(owner_url, key, timeout_s=timeout_s)
+
+    client.fetch_page = wrapper
+    try:
+        yield stats
+    finally:
+        _restore(client, "fetch_page", wrapper, original)
+
+
+@contextlib.contextmanager
 def slow_decode(decoder, delay_s: float = 0.2) -> Iterator[dict]:
     """Slow/stuck-lane injector: every decode_step stalls `delay_s`, so a
     serving request with a deadline goes overdue mid-decode and the

@@ -758,3 +758,96 @@ def test_router_bench_smoke_contract(capsys):
     assert r["post_kill_success_rate"] == 1.0
     assert r["breaker_opened"] is True
     assert r["routed_ok"] == r["routed_requests"]
+    # ISSUE 20: the shared-prefix rung prices cache-on vs cache-off.
+    ps = doc["extras"]["page_share"]
+    assert "error" not in ps, ps
+    assert ps["cross_replica_hit_rate"] > 0
+    assert ps["remote_hit_admissions"] >= 1 and ps["pull_failures"] == 0
+    assert ps["prefill_tokens_cache_on"] < ps["prefill_tokens_cache_off"]
+    assert ps["prefill_seconds_cache_off"] > 0
+
+
+# -- fleet page index (ISSUE 20: cross-replica page sharing) ----------------
+
+def test_page_report_registered_replicas_only_then_fifo_cap():
+    """Only registered replica URLs are indexed (an unknown reporter
+    could otherwise poison every lookup); the index is FIFO-bounded;
+    last reporter wins per key."""
+    env = make_router(n=2, page_index_capacity=3)
+    out = env.router.handle_page_report(
+        {"replica": "http://evil/x", "keys": ["k1"]})
+    assert out == {"indexed": 0, "known": False}
+    assert env.router.handle_page_lookup({"keys": ["k1"]})["owner"] is None
+    out = env.router.handle_page_report(
+        {"replica": "http://sim/r0", "keys": ["k1", "k2"]})
+    assert out == {"indexed": 2, "known": True}
+    # Last reporter wins: r1 re-reports k2.
+    env.router.handle_page_report(
+        {"replica": "http://sim/r1", "keys": ["k2"]})
+    assert env.router.handle_page_lookup(
+        {"keys": ["k2"]})["owner"] == "http://sim/r1"
+    # Beyond capacity the OLDEST key falls out, never the newest.
+    env.router.handle_page_report(
+        {"replica": "http://sim/r0", "keys": ["k3", "k4"]})
+    assert env.router.handle_page_lookup({"keys": ["k1"]})["owner"] is None
+    assert env.router.handle_page_lookup(
+        {"keys": ["k4"]})["owner"] == "http://sim/r0"
+    assert metric_line(env.router.registry, "router_page_index_keys") == 3
+    assert metric_line(
+        env.router.registry, "router_page_reports_total") == 5
+
+
+def test_page_lookup_contiguous_prefix_have_offset_and_health():
+    """Lookup names one owner for a contiguous run from `have`, skips
+    the asker, and never points a puller at a replica the router would
+    not route a request to."""
+    env = make_router(n=2)
+    env.router.handle_page_report(
+        {"replica": "http://sim/r0", "keys": ["a", "b", "c"]})
+    # The covered prefix stops at the first key the owner lacks.
+    res = env.router.handle_page_lookup(
+        {"keys": ["a", "b", "zz"], "exclude": "http://sim/r1"})
+    assert res["owner"] == "http://sim/r0" and res["keys"] == ["a", "b"]
+    # have>0: the asker's resident prefix is covered without ownership
+    # checks (it will not pull those), extension stays contiguous.
+    res = env.router.handle_page_lookup({"keys": ["a", "b", "c"], "have": 1})
+    assert res["owner"] == "http://sim/r0"
+    assert res["keys"] == ["a", "b", "c"]
+    assert env.router.handle_page_lookup(
+        {"keys": ["a"], "have": 5})["owner"] is None
+    # The asker never pulls from itself.
+    assert env.router.handle_page_lookup(
+        {"keys": ["a"], "exclude": "http://sim/r0"})["owner"] is None
+    # Unhealthy owners are invisible: down status, then open breaker.
+    r0 = env.router.replicas[0]
+    r0.status = "down"
+    assert env.router.handle_page_lookup({"keys": ["a"]})["owner"] is None
+    r0.status = "ok"
+    r0.breaker.trip("probe failed")
+    assert env.router.handle_page_lookup({"keys": ["a"]})["owner"] is None
+    # ...and the half-open probe slot is NOT consumed by lookups.
+    env.clock.advance(6.0)
+    assert env.router.handle_page_lookup({"keys": ["a"]})["owner"] is None
+    assert r0.breaker.state == "open"  # lookup never called allow()
+    assert r0.breaker.allow()  # the probe slot is still armed
+
+
+def test_fleet_payload_shared_index_columns():
+    env = make_router(n=2)
+    env.router.handle_page_report(
+        {"replica": "http://sim/r0", "keys": ["a", "b"]})
+    by = {r["replica"]: r for r in env.router.fleet_payload()["replicas"]}
+    assert by["r0"]["shared_pages"] == 2 and by["r0"]["page_reports"] == 2
+    assert by["r1"]["shared_pages"] == 0 and by["r1"]["page_reports"] == 0
+
+
+def test_page_index_http_routes(fleet_url):
+    f = fleet_url
+    key = "ab" * 32
+    code, body = _post(f.url, "/pages/report",
+                       {"replica": f.replica_urls[0], "keys": [key]})
+    assert code == 200 and body == {"indexed": 1, "known": True}
+    code, body = _post(f.url, "/pages/lookup",
+                       {"keys": [key], "exclude": f.replica_urls[1]})
+    assert code == 200
+    assert body["owner"] == f.replica_urls[0] and body["keys"] == [key]

@@ -242,7 +242,11 @@ def test_concurrent_sample_scrape_emit_race():
     c = reg.counter("c_total", "")
     h = reg.histogram("h", "", buckets=(0.01, 0.1, 1.0))
     g = reg.gauge("g", "")
-    ring = TimeSeriesRing(reg, interval_s=1.0, capacity=4096)
+    # Capacity must exceed the free-running sampler's iteration count
+    # for the whole window: once the ring wraps, the oldest counter
+    # deltas are (correctly) evicted and the exact-sum assertion below
+    # no longer holds — that's capacity semantics, not a race.
+    ring = TimeSeriesRing(reg, interval_s=1.0, capacity=65536)
     stop = threading.Event()
     errors = []
 
